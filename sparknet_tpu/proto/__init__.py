@@ -1,0 +1,19 @@
+"""Prototxt / binaryproto codecs for the Caffe protobuf dialect.
+
+Replaces the reference's protobuf-java + native text-parse round trip
+(reference ProtoLoader.scala, ccaffe.cpp:213-242) with a pure-Python,
+schema-driven implementation. Stock ``.prototxt`` and ``.caffemodel``
+files from the reference load unchanged.
+"""
+
+from .message import Message
+from . import schema, text_format, wire
+from .text_format import load as load_prototxt, loads as parse_prototxt
+from .text_format import dump as save_prototxt, dumps as format_prototxt
+from .wire import load as load_binaryproto, dump as save_binaryproto
+
+__all__ = [
+    "Message", "schema", "text_format", "wire",
+    "load_prototxt", "parse_prototxt", "save_prototxt", "format_prototxt",
+    "load_binaryproto", "save_binaryproto",
+]

@@ -1,0 +1,175 @@
+"""Schema-aware dynamic protobuf message objects.
+
+``Message("LayerParameter")`` behaves like the generated protobuf class the
+reference's JVM side uses (``caffe.Caffe.LayerParameter``): attribute access
+returns set values or proto2 defaults, repeated fields are lists, and
+``has_*`` distinguishes set-vs-default (which Caffe's pooling layer setup
+relies on, reference pooling_layer.cpp:21-36).
+"""
+
+import copy as _copy
+import struct as _struct
+
+from . import schema
+
+
+class Message:
+    __slots__ = ("_type", "_fields")
+
+    def __init__(self, type_name, **kwargs):
+        if type_name not in schema.MESSAGES:
+            raise ValueError(f"unknown message type {type_name!r}")
+        object.__setattr__(self, "_type", type_name)
+        object.__setattr__(self, "_fields", {})
+        for k, v in kwargs.items():
+            setattr(self, k, v)
+
+    # -- introspection -----------------------------------------------------
+    @property
+    def type_name(self):
+        return self._type
+
+    def spec(self, name):
+        try:
+            return schema.MESSAGES[self._type][name]
+        except KeyError:
+            raise AttributeError(f"{self._type} has no field {name!r}") from None
+
+    def field_names(self):
+        return schema.MESSAGES[self._type].keys()
+
+    def set_fields(self):
+        """Names of explicitly-set fields, in set order."""
+        return list(self._fields.keys())
+
+    def has(self, name):
+        self.spec(name)
+        v = self._fields.get(name)
+        if v is None:
+            return False
+        return True if not isinstance(v, list) else len(v) > 0
+
+    # -- attribute protocol ------------------------------------------------
+    def __getattr__(self, name):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        if name.startswith("has_"):
+            fname = name[4:]
+            return lambda: self.has(fname)
+        num, ftype, label, default = self.spec(name)
+        if name in self._fields:
+            return self._fields[name]
+        if label != "opt":
+            lst = []
+            self._fields[name] = lst  # cached so appends stick
+            return lst
+        if schema.is_message(ftype):
+            return None
+        if default is not None:
+            return default
+        return schema.zero_value(ftype)
+
+    def __setattr__(self, name, value):
+        num, ftype, label, default = self.spec(name)
+        if label != "opt":
+            value = [self._coerce(ftype, v) for v in value]
+        elif value is None:
+            self._fields.pop(name, None)
+            return
+        else:
+            value = self._coerce(ftype, value)
+        self._fields[name] = value
+
+    def _coerce(self, ftype, value):
+        if schema.is_message(ftype):
+            if isinstance(value, Message):
+                if value.type_name != ftype:
+                    raise TypeError(f"expected {ftype}, got {value.type_name}")
+                return value
+            if isinstance(value, dict):
+                return Message(ftype, **value)
+            raise TypeError(f"expected {ftype} message, got {type(value)}")
+        if schema.is_enum(ftype):
+            if isinstance(value, str):
+                return schema.ENUMS[ftype][value]
+            return int(value)
+        if ftype == "float":
+            # proto2 'float' is 32-bit on the wire; quantize at set time so
+            # text-parsed and wire-parsed values agree exactly.
+            return _struct.unpack("<f", _struct.pack("<f", float(value)))[0]
+        if ftype == "double":
+            return float(value)
+        if ftype in schema.INT_TYPES:
+            return int(value)
+        if ftype == "bool":
+            return bool(value)
+        if ftype == "string":
+            return str(value)
+        if ftype == "bytes":
+            return bytes(value)
+        raise TypeError(f"unknown field type {ftype}")
+
+    # -- mutation helpers --------------------------------------------------
+    def add(self, _field, **kwargs):
+        """Append and return a new sub-message on a repeated message field."""
+        name = _field
+        num, ftype, label, default = self.spec(name)
+        if label == "opt" or not schema.is_message(ftype):
+            raise ValueError(f"{name} is not a repeated message field")
+        msg = Message(ftype, **kwargs)
+        getattr(self, name).append(msg)
+        return msg
+
+    def ensure(self, name):
+        """Return the sub-message field, creating it if unset (mutable_* analog)."""
+        num, ftype, label, default = self.spec(name)
+        if not schema.is_message(ftype) or label != "opt":
+            raise ValueError(f"{name} is not an optional message field")
+        if name not in self._fields:
+            self._fields[name] = Message(ftype)
+        return self._fields[name]
+
+    def clear(self, name):
+        self._fields.pop(name, None)
+
+    def copy(self):
+        return _copy.deepcopy(self)
+
+    def merge_from(self, other):
+        """Proto2 MergeFrom: scalars overwrite, repeateds concatenate,
+        sub-messages merge recursively."""
+        if other.type_name != self._type:
+            raise TypeError(f"cannot merge {other.type_name} into {self._type}")
+        for name in other.set_fields():
+            num, ftype, label, default = self.spec(name)
+            val = other._fields[name]
+            if label != "opt":
+                getattr(self, name).extend(_copy.deepcopy(val))
+            elif schema.is_message(ftype) and name in self._fields:
+                self._fields[name].merge_from(val)
+            else:
+                self._fields[name] = _copy.deepcopy(val)
+
+    # -- misc --------------------------------------------------------------
+    def __eq__(self, other):
+        if not isinstance(other, Message) or other.type_name != self._type:
+            return NotImplemented
+        names = set(self.set_fields()) | set(other.set_fields())
+        for n in names:
+            a, b = getattr(self, n), getattr(other, n)
+            if a != b:
+                return False
+        return True
+
+    def __repr__(self):
+        from .text_format import dumps
+        return f"<{self._type}\n{dumps(self)}>"
+
+    def enum_name(self, field):
+        """Symbolic name of an enum field's current value."""
+        num, ftype, label, default = self.spec(field)
+        val = getattr(self, field)
+        for k, v in schema.ENUMS[ftype].items():
+            if v == val:
+                return k
+        return str(val)

@@ -1,0 +1,217 @@
+"""Protobuf text-format (prototxt) reader/writer.
+
+Replaces the reference's C++ round-trip service (the JVM called into native
+code just to parse prototxt: reference ProtoLoader.scala:9-29 / ccaffe.cpp:213-242).
+Here it is a direct recursive-descent parser over the schema in
+``schema.py`` — stock Caffe ``.prototxt`` files load unchanged.
+"""
+
+import re
+
+import numpy as np
+
+from . import schema
+from .message import Message
+
+_TOKEN = re.compile(
+    r"""\s*(?:
+        (?P<comment>\#[^\n]*)
+      | (?P<brace>[{}])
+      | (?P<colon>:)
+      | (?P<string>"(?:\\.|[^"\\])*"|'(?:\\.|[^'\\])*')
+      | (?P<ident>[A-Za-z_][A-Za-z0-9_]*)
+      | (?P<number>[-+]?(?:\d+\.\d*(?:[eE][-+]?\d+)?|\.\d+(?:[eE][-+]?\d+)?
+                        |\d+[eE][-+]?\d+|0[xX][0-9a-fA-F]+|\d+))
+    )""",
+    re.VERBOSE,
+)
+
+_ESCAPES = {"n": "\n", "t": "\t", "r": "\r", '"': '"', "'": "'", "\\": "\\",
+            "0": "\0", "a": "\a", "b": "\b", "f": "\f", "v": "\v"}
+
+
+def _tokenize(text):
+    pos, n = 0, len(text)
+    while pos < n:
+        m = _TOKEN.match(text, pos)
+        if not m:
+            if text[pos:].strip() == "":
+                return
+            raise ValueError(f"prototxt parse error at offset {pos}: "
+                             f"{text[pos:pos+40]!r}")
+        pos = m.end()
+        kind = m.lastgroup
+        if kind == "comment" or kind is None:
+            continue
+        yield kind, m.group(kind)
+
+
+def _unquote(tok):
+    body = tok[1:-1]
+    out, i = [], 0
+    while i < len(body):
+        c = body[i]
+        if c == "\\" and i + 1 < len(body):
+            nxt = body[i + 1]
+            if nxt in _ESCAPES:
+                out.append(_ESCAPES[nxt])
+                i += 2
+                continue
+            if nxt.isdigit():  # octal escape
+                j = i + 1
+                while j < len(body) and j < i + 4 and body[j].isdigit():
+                    j += 1
+                out.append(chr(int(body[i + 1:j], 8)))
+                i = j
+                continue
+        out.append(c)
+        i += 1
+    return "".join(out)
+
+
+class _Parser:
+    def __init__(self, text):
+        self.toks = list(_tokenize(text))
+        self.i = 0
+
+    def peek(self):
+        return self.toks[self.i] if self.i < len(self.toks) else (None, None)
+
+    def next(self):
+        tok = self.peek()
+        self.i += 1
+        return tok
+
+    def expect(self, kind, value=None):
+        k, v = self.next()
+        if k != kind or (value is not None and v != value):
+            raise ValueError(f"expected {value or kind}, got {v!r}")
+        return v
+
+    def parse_message(self, msg, top_level=False):
+        while True:
+            k, v = self.peek()
+            if k is None:
+                if not top_level:
+                    raise ValueError("unexpected EOF inside message")
+                return msg
+            if k == "brace" and v == "}":
+                if top_level:
+                    raise ValueError("unbalanced '}'")
+                self.next()
+                return msg
+            if k != "ident":
+                raise ValueError(f"expected field name, got {v!r}")
+            self.next()
+            self._parse_field(msg, v)
+
+    def _parse_field(self, msg, name):
+        num, ftype, label, default = msg.spec(name)
+        k, v = self.peek()
+        if schema.is_message(ftype):
+            if k == "colon":  # optional colon before submessage
+                self.next()
+                k, v = self.peek()
+            self.expect("brace", "{")
+            sub = Message(ftype)
+            self.parse_message(sub)
+            if label == "opt":
+                setattr(msg, name, sub)
+            else:
+                getattr(msg, name).append(sub)
+            return
+        self.expect("colon")
+        value = self._parse_scalar(ftype)
+        if label == "opt":
+            setattr(msg, name, value)
+        else:
+            getattr(msg, name).append(msg._coerce(ftype, value))
+
+    def _parse_scalar(self, ftype):
+        k, v = self.next()
+        if ftype in ("string", "bytes"):
+            if k != "string":
+                raise ValueError(f"expected quoted string, got {v!r}")
+            s = _unquote(v)
+            return s.encode("utf-8") if ftype == "bytes" else s
+        if ftype == "bool":
+            if k == "ident":
+                if v in ("true", "True"):
+                    return True
+                if v in ("false", "False"):
+                    return False
+                raise ValueError(f"bad bool {v!r}")
+            return bool(int(v, 0))
+        if schema.is_enum(ftype):
+            if k == "ident":
+                try:
+                    return schema.ENUMS[ftype][v]
+                except KeyError:
+                    raise ValueError(f"bad enum value {v!r} for {ftype}") from None
+            return int(v, 0)
+        if ftype in ("float", "double"):
+            if k == "ident" and v in ("inf", "nan"):
+                return float(v)
+            return float(v)
+        if ftype in schema.INT_TYPES:
+            return int(v, 0)
+        raise ValueError(f"unhandled scalar type {ftype}")
+
+
+def loads(text, type_name):
+    """Parse prototxt ``text`` as a message of ``type_name``."""
+    return _Parser(text).parse_message(Message(type_name), top_level=True)
+
+
+def load(path, type_name):
+    with open(path, "r") as f:
+        return loads(f.read(), type_name)
+
+
+def _fmt_scalar(ftype, value):
+    if ftype in ("string", "bytes"):
+        if isinstance(value, bytes):
+            value = value.decode("utf-8", "backslashreplace")
+        esc = value.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+        return f'"{esc}"'
+    if ftype == "bool":
+        return "true" if value else "false"
+    if schema.is_enum(ftype):
+        for k, v in schema.ENUMS[ftype].items():
+            if v == value:
+                return k
+        return str(value)
+    if ftype in ("float", "double"):
+        if value == int(value) and abs(value) < 1e15:
+            return str(int(value))
+        if ftype == "float":
+            for p in range(1, 10):  # shortest decimal that round-trips as f32
+                s = f"{value:.{p}g}"
+                if np.float32(s) == np.float32(value):
+                    return s
+        return repr(value)
+    return str(value)
+
+
+def dumps(msg, indent=0):
+    """Render a Message as prototxt (fields in set order, Caffe style)."""
+    pad = "  " * indent
+    lines = []
+    for name in msg.set_fields():
+        num, ftype, label, default = msg.spec(name)
+        values = getattr(msg, name)
+        if label == "opt":
+            values = [values]
+        for v in values:
+            if schema.is_message(ftype):
+                lines.append(f"{pad}{name} {{")
+                lines.append(dumps(v, indent + 1).rstrip("\n"))
+                lines.append(f"{pad}}}")
+            else:
+                lines.append(f"{pad}{name}: {_fmt_scalar(ftype, v)}")
+    return "\n".join(x for x in lines if x != "") + ("\n" if lines else "")
+
+
+def dump(msg, path):
+    with open(path, "w") as f:
+        f.write(dumps(msg))

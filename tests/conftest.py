@@ -1,0 +1,22 @@
+"""Test configuration: force an 8-device virtual CPU platform.
+
+Multi-chip TPU hardware is not available in CI; JAX's host-platform device
+virtualization gives every test a deterministic 8-device mesh — the
+"fake backend" story the reference never had (its only distributed test,
+ImageNetLoaderSpec, was @ignore'd; see SURVEY.md section 4).
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+REFERENCE = "/root/reference"
+
+
+def reference_path(*parts):
+    return os.path.join(REFERENCE, *parts)
