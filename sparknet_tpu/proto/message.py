@@ -13,6 +13,39 @@ import struct as _struct
 from . import schema
 
 
+class RepeatedField(list):
+    """List that coerces scalar appends to the field's proto type (so e.g.
+    float fields are f32-quantized no matter how values arrive)."""
+
+    __slots__ = ("_owner", "_ftype")
+
+    def __init__(self, owner, ftype, values=()):
+        self._owner = owner
+        self._ftype = ftype
+        super().__init__(owner._coerce(ftype, v) for v in values)
+
+    def append(self, v):
+        super().append(self._owner._coerce(self._ftype, v))
+
+    def extend(self, values):
+        super().extend(self._owner._coerce(self._ftype, v) for v in values)
+
+    def insert(self, i, v):
+        super().insert(i, self._owner._coerce(self._ftype, v))
+
+    def __setitem__(self, i, v):
+        if isinstance(i, slice):
+            v = [self._owner._coerce(self._ftype, x) for x in v]
+        else:
+            v = self._owner._coerce(self._ftype, v)
+        super().__setitem__(i, v)
+
+    def extend_raw(self, values):
+        """Bulk extend without per-element coercion (wire decode fast path —
+        values are already exact)."""
+        super().extend(values)
+
+
 class Message:
     __slots__ = ("_type", "_fields")
 
@@ -60,7 +93,7 @@ class Message:
         if name in self._fields:
             return self._fields[name]
         if label != "opt":
-            lst = []
+            lst = RepeatedField(self, ftype)
             self._fields[name] = lst  # cached so appends stick
             return lst
         if schema.is_message(ftype):
@@ -72,7 +105,7 @@ class Message:
     def __setattr__(self, name, value):
         num, ftype, label, default = self.spec(name)
         if label != "opt":
-            value = [self._coerce(ftype, v) for v in value]
+            value = RepeatedField(self, ftype, value)
         elif value is None:
             self._fields.pop(name, None)
             return
@@ -135,6 +168,22 @@ class Message:
     def copy(self):
         return _copy.deepcopy(self)
 
+    def __deepcopy__(self, memo):
+        new = Message(self._type)
+        for name in self.set_fields():
+            num, ftype, label, default = self.spec(name)
+            val = self._fields[name]
+            if label != "opt":
+                new._fields[name] = RepeatedField(
+                    new, ftype,
+                    (_copy.deepcopy(v, memo) if isinstance(v, Message) else v
+                     for v in val))
+            elif isinstance(val, Message):
+                new._fields[name] = _copy.deepcopy(val, memo)
+            else:
+                new._fields[name] = val
+        return new
+
     def merge_from(self, other):
         """Proto2 MergeFrom: scalars overwrite, repeateds concatenate,
         sub-messages merge recursively."""
@@ -144,11 +193,15 @@ class Message:
             num, ftype, label, default = self.spec(name)
             val = other._fields[name]
             if label != "opt":
-                getattr(self, name).extend(_copy.deepcopy(val))
+                getattr(self, name).extend(
+                    _copy.deepcopy(v) if isinstance(v, Message) else v
+                    for v in val)
             elif schema.is_message(ftype) and name in self._fields:
                 self._fields[name].merge_from(val)
-            else:
+            elif isinstance(val, Message):
                 self._fields[name] = _copy.deepcopy(val)
+            else:
+                self._fields[name] = val
 
     # -- misc --------------------------------------------------------------
     def __eq__(self, other):

@@ -95,6 +95,9 @@ def _decode(buf, pos, end, type_name):
             continue
         name, (num, ftype, label, default) = entry
         if schema.is_message(ftype):
+            if wt != _WT_LEN:
+                pos = _skip(buf, pos, wt)
+                continue
             n, pos = _read_varint(buf, pos)
             sub = _decode(buf, pos, pos + n, ftype)
             pos += n
@@ -107,23 +110,29 @@ def _decode(buf, pos, end, type_name):
                 getattr(msg, name).append(sub)
             continue
         scalar_wt = _WT_VARINT if schema.is_enum(ftype) else _SCALAR_WIRETYPE[ftype]
-        if wt == _WT_LEN and scalar_wt in (_WT_VARINT, _WT_32BIT, _WT_64BIT):
+        if (wt == _WT_LEN and scalar_wt != _WT_LEN):
+            if label == "opt":
+                # wire-type mismatch on a non-repeated scalar: unknown field
+                pos = _skip(buf, pos, wt)
+                continue
             # packed repeated scalars
             n, pos = _read_varint(buf, pos)
             stop = pos + n
             tgt = getattr(msg, name)
             if ftype == "float":
-                arr = np.frombuffer(buf[pos:stop], dtype="<f4")
-                tgt.extend(arr.tolist())
+                tgt.extend_raw(np.frombuffer(buf[pos:stop], dtype="<f4")
+                               .astype(np.float64).tolist())
                 pos = stop
             elif ftype == "double":
-                arr = np.frombuffer(buf[pos:stop], dtype="<f8")
-                tgt.extend(arr.tolist())
+                tgt.extend_raw(np.frombuffer(buf[pos:stop], dtype="<f8").tolist())
                 pos = stop
             else:
                 while pos < stop:
                     v, pos = _read_varint(buf, pos)
                     tgt.append(self_val(ftype, v))
+            continue
+        if wt != scalar_wt:
+            pos = _skip(buf, pos, wt)
             continue
         value, pos = _read_scalar(buf, pos, wt, ftype)
         if label == "opt":

@@ -160,11 +160,20 @@ class TestWireFormat:
         assert out == b
         assert list(out.shape.dim) == [2, 3]
 
-    def test_unknown_fields_skipped(self):
-        # encode a LayerParameter, decode as NetParameter: all fields unknown
+    def test_mismatched_fields_skipped(self):
+        # LayerParameter's name/type (length-delimited, fields 1/2) decoded as
+        # NetState (varint fields 1/2): wire-type mismatch -> unknown -> skip
         l = Message("LayerParameter", name="x", type="ReLU")
-        decoded = wire.decode(wire.encode(l), "BlobShape")
-        assert decoded == Message("BlobShape")
+        decoded = wire.decode(wire.encode(l), "NetState")
+        assert decoded == Message("NetState")
+
+    def test_unknown_field_numbers_skipped(self):
+        # field 100 (layer) is unknown to SolverState; name (1) is wt-compatible
+        n = Message("NetParameter", name="n")
+        n.add("layer", name="l")
+        decoded = wire.decode(wire.encode(n), "SolverState")
+        assert decoded.iter is None or decoded.iter == 0  # nothing meaningful set
+        assert not decoded.has("history")
 
     def test_negative_int(self):
         s = Message("SolverParameter", random_seed=-1, clip_gradients=-1.0)
