@@ -1,0 +1,7 @@
+"""Graph compiler: NetParameter -> pure init/apply (replaces caffe::Net)."""
+
+from .compiler import CompiledNet, filter_net, upgrade_v1, TRAIN, TEST
+from .registry import register, get, Layer
+
+__all__ = ["CompiledNet", "filter_net", "upgrade_v1", "TRAIN", "TEST",
+           "register", "get", "Layer"]

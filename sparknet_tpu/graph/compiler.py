@@ -1,0 +1,392 @@
+"""NetParameter -> pure init/apply functions: the graph compiler.
+
+The TPU-native replacement for Caffe's Net runtime (reference net.cpp:
+FilterNet :287, split insertion :54, AppendTop/Bottom :385/:444, param
+ownership & sharing, ForwardFromTo :565). Differences born of the platform:
+
+  * No Split insertion — autodiff accumulates fan-out gradients natively.
+  * No Backward graph — ``jax.grad`` of the compiled loss is the backward.
+  * In-place ops (ReLU with top==bottom) are SSA rebinds of the blob name.
+  * Data layers are feeds (see ops.feed): the compiled step takes a
+    ``batch`` dict; nothing inside the graph performs IO.
+  * BatchNorm-style mutable blobs are explicit functional state threaded
+    through ``apply`` (Caffe mutates blobs_ in place).
+
+The whole forward (and the grad through it) traces into ONE XLA program:
+layer fusion, scheduling and memory planning are XLA's job, per the
+compilation model in /opt/skills/guides (trace once, static shapes).
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..proto.message import Message
+from . import fillers as F
+from .registry import get as get_layer, V1_TYPE_MAP
+
+# import for registration side effects
+from .. import ops as _ops  # noqa: F401
+
+TRAIN, TEST = 0, 1
+
+
+def upgrade_v1(net_param):
+    """Upgrade legacy V1 'layers' to V2 'layer' entries (the capability of
+    reference util/upgrade_proto.cpp, re-derived from the schema mapping)."""
+    if not net_param.layers:
+        return net_param
+    out = net_param.copy()
+    out.clear("layers")
+    for v1 in net_param.layers:
+        lp = out.add("layer")
+        if v1.has("name"):
+            lp.name = v1.name
+        if v1.has("type"):
+            lp.type = V1_TYPE_MAP[v1.enum_name("type")]
+        lp.bottom.extend(v1.bottom)
+        lp.top.extend(v1.top)
+        lp.loss_weight.extend(v1.loss_weight)
+        for r in v1.include:
+            lp.include.append(r.copy())
+        for r in v1.exclude:
+            lp.exclude.append(r.copy())
+        for b in v1.blobs:
+            lp.blobs.append(b.copy())
+        # blobs_lr / weight_decay pairs -> ParamSpecs
+        n = max(len(v1.blobs_lr), len(v1.weight_decay))
+        for i in range(n):
+            ps = lp.add("param")
+            if i < len(v1.blobs_lr):
+                ps.lr_mult = v1.blobs_lr[i]
+            if i < len(v1.weight_decay):
+                ps.decay_mult = v1.weight_decay[i]
+        for f in ("accuracy_param", "argmax_param", "concat_param",
+                  "contrastive_loss_param", "convolution_param", "data_param",
+                  "dropout_param", "dummy_data_param", "eltwise_param",
+                  "exp_param", "hdf5_data_param", "hdf5_output_param",
+                  "hinge_loss_param", "image_data_param",
+                  "infogain_loss_param", "inner_product_param", "lrn_param",
+                  "memory_data_param", "mvn_param", "pooling_param",
+                  "power_param", "relu_param", "sigmoid_param",
+                  "softmax_param", "slice_param", "tanh_param",
+                  "threshold_param", "window_data_param", "transform_param",
+                  "loss_param"):
+            if v1.has(f):
+                setattr(lp, f, getattr(v1, f).copy())
+    return out
+
+
+def _rule_matches(rule, state):
+    """NetStateRule vs NetState (reference net.cpp StateMeetsRule)."""
+    if rule.has("phase") and rule.phase != state.phase:
+        return False
+    if rule.has("min_level") and state.level < rule.min_level:
+        return False
+    if rule.has("max_level") and state.level > rule.max_level:
+        return False
+    stages = set(state.stage)
+    for s in rule.stage:
+        if s not in stages:
+            return False
+    for s in rule.not_stage:
+        if s in stages:
+            return False
+    return True
+
+
+def filter_net(net_param, phase, level=0, stages=()):
+    """Phase/level/stage filtering (reference net.cpp FilterNet :287)."""
+    state = Message("NetState", phase=phase, level=level, stage=list(stages))
+    out = net_param.copy()
+    out.clear("layer")
+    for lp in net_param.layer:
+        inc = lp.include
+        exc = lp.exclude
+        if inc and exc:
+            raise ValueError(f"layer {lp.name}: both include and exclude rules")
+        keep = True
+        if inc:
+            keep = any(_rule_matches(r, state) for r in inc)
+        elif exc:
+            keep = not any(_rule_matches(r, state) for r in exc)
+        if keep and lp.has("phase") and lp.phase != phase:
+            keep = False
+        if keep:
+            out.layer.append(lp.copy())
+    return out
+
+
+class CompiledNet:
+    """A phase-specific executable net.
+
+    build: shape-infers every blob, instantiates layer impls, and indexes
+    params (with cross-layer sharing via ParamSpec.name, reference net.cpp
+    AppendParam).
+
+      init(rng)                      -> (params, state)
+      apply(params, state, batch, train=..., rng=...) -> (blobs, new_state)
+      loss_fn(params, state, batch, rng)  -> loss, (blobs, new_state)
+
+    params:  {layer_name: [jnp arrays]}   (owning layers only)
+    state:   {layer_name: [jnp arrays]}   (e.g. BatchNorm running stats)
+    blobs:   {blob_name: array} after the full forward
+    """
+
+    def __init__(self, net_param, phase=TRAIN, feed_shapes=None,
+                 dtype=jnp.float32, level=0, stages=()):
+        net_param = upgrade_v1(net_param)
+        self.phase = phase
+        self.dtype = dtype
+        self.net_param = filter_net(net_param, phase, level, stages)
+        self.name = net_param.name
+        feed_shapes = dict(feed_shapes or {})
+
+        self.layers = []          # [(lp, impl, bottoms, tops)]
+        self.param_refs = {}      # layer_name -> [(owner_name, idx)]
+        self.param_meta = {}      # (owner, idx) -> (shape, filler, lr, decay)
+        self.loss_weights = {}    # layer_name -> [w per top]
+        shared = {}               # ParamSpec.name -> (owner, idx)
+        blob_shapes = {}
+        available = {}            # blob name -> producing layer (output tracking)
+
+        # net-level inputs (deploy nets: net.input + input_shape/input_dim)
+        self.net_inputs = list(self.net_param.input)
+        if self.net_inputs:
+            if self.net_param.input_shape:
+                in_shapes = [tuple(int(d) for d in s.dim)
+                             for s in self.net_param.input_shape]
+            else:
+                dims = [int(d) for d in self.net_param.input_dim]
+                in_shapes = [tuple(dims[i:i + 4])
+                             for i in range(0, len(dims), 4)]
+            for nm, s in zip(self.net_inputs, in_shapes):
+                blob_shapes[nm] = s
+                available[nm] = "__input__"
+
+        for li, lp in enumerate(self.net_param.layer):
+            cls = get_layer(lp.type)
+            bottoms = list(lp.bottom)
+            tops = list(lp.top)
+            for b in bottoms:
+                if b not in blob_shapes:
+                    raise ValueError(
+                        f"layer {lp.name!r}: bottom {b!r} is undefined")
+            bshapes = [blob_shapes[b] for b in bottoms]
+            if getattr(cls, "is_feed", False):
+                impl = cls(lp, bshapes, phase, feed_shapes=feed_shapes)
+            else:
+                impl = cls(lp, bshapes, phase)
+            tshapes = impl.out_shapes()
+            if len(tshapes) != len(tops):
+                raise ValueError(
+                    f"layer {lp.name!r} ({lp.type}): {len(tops)} tops declared "
+                    f"but impl produces {len(tshapes)}")
+            for b in bottoms:
+                available.pop(b, None)
+            for t, s in zip(tops, tshapes):
+                blob_shapes[t] = tuple(s)
+                available[t] = lp.name
+            self.layers.append((lp, impl, bottoms, tops))
+
+            # params (with sharing)
+            refs = []
+            pshapes = impl.param_shapes()
+            for i, (shape, filler, lr_mult, decay_mult) in enumerate(pshapes):
+                pname = lp.param[i].name if i < len(lp.param) and \
+                    lp.param[i].has("name") else ""
+                if pname and pname in shared:
+                    owner = shared[pname]
+                    oshape = self.param_meta[owner][0]
+                    if int(np.prod(oshape)) != int(np.prod(shape)):
+                        raise ValueError(
+                            f"shared param {pname!r}: count mismatch")
+                    refs.append(owner)
+                else:
+                    key = (lp.name, i)
+                    self.param_meta[key] = (tuple(shape), filler,
+                                            float(lr_mult), float(decay_mult))
+                    if pname:
+                        shared[pname] = key
+                    refs.append(key)
+            self.param_refs[lp.name] = refs
+
+            # loss weights (reference layer.hpp SetLossWeights: *Loss layers
+            # default top[0] weight to 1)
+            ws = list(lp.loss_weight)
+            if not ws:
+                ws = [1.0] + [0.0] * (len(tops) - 1) if impl.loss_like \
+                    else [0.0] * len(tops)
+            elif len(ws) != len(tops):
+                raise ValueError(f"layer {lp.name}: loss_weight count mismatch")
+            self.loss_weights[lp.name] = ws
+
+        self.blob_shapes = blob_shapes
+        # net outputs: produced and never consumed (net.cpp:270-284)
+        self.output_blobs = [b for b, l in available.items()
+                             if l != "__input__"]
+
+    # -- feeds -------------------------------------------------------------
+    def feed_blobs(self):
+        """Blob names the batch dict must provide."""
+        names = list(self.net_inputs)
+        for lp, impl, bottoms, tops in self.layers:
+            if getattr(impl, "is_feed", False):
+                names.extend(tops)
+        return names
+
+    def feed_shapes(self):
+        return {n: self.blob_shapes[n] for n in self.feed_blobs()}
+
+    # -- init --------------------------------------------------------------
+    def init(self, rng):
+        params, state = {}, {}
+        keys_needed = sorted(self.param_meta.keys())
+        keys = jax.random.split(rng, max(1, len(keys_needed)))
+        key_of = dict(zip(keys_needed, keys))
+        for lp, impl, bottoms, tops in self.layers:
+            owned = [k for k in self.param_refs[lp.name] if k[0] == lp.name]
+            if owned:
+                blobs = []
+                for key in owned:
+                    shape, filler, lr, decay = self.param_meta[key]
+                    blobs.append(F.fill(key_of[key], shape, filler,
+                                        self.dtype))
+                params[lp.name] = blobs
+            ss = impl.state_shapes()
+            if ss:
+                state[lp.name] = [jnp.full(shape, val, self.dtype)
+                                  for shape, val in ss]
+        # pretrained blobs embedded in the prototxt (LayerParameter.blobs)
+        self._load_embedded_blobs(params)
+        return params, state
+
+    def _load_embedded_blobs(self, params):
+        for lp, impl, bottoms, tops in self.layers:
+            if lp.blobs and lp.name in params:
+                for i, bp in enumerate(lp.blobs):
+                    if i < len(params[lp.name]):
+                        arr = blob_to_array(bp)
+                        params[lp.name][i] = jnp.asarray(
+                            arr.reshape(params[lp.name][i].shape), self.dtype)
+
+    def resolve_params(self, params, layer_name):
+        out = []
+        for owner, idx in self.param_refs[layer_name]:
+            out.append(params[owner][idx])
+        return out
+
+    # -- forward -----------------------------------------------------------
+    def apply(self, params, state, batch, train=None, rng=None):
+        """Run the forward pass. Pure; jit/grad-safe."""
+        if train is None:
+            train = (self.phase == TRAIN)
+        if rng is None:
+            rng = jax.random.PRNGKey(0)
+        blobs = {}
+        for n in self.net_inputs:
+            blobs[n] = jnp.asarray(batch[n])
+        new_state = dict(state)
+        for li, (lp, impl, bottoms, tops) in enumerate(self.layers):
+            if getattr(impl, "is_feed", False):
+                for t in tops:
+                    blobs[t] = jnp.asarray(batch[t])
+                continue
+            lparams = self.resolve_params(params, lp.name)
+            bvals = [blobs[b] for b in bottoms]
+            lrng = jax.random.fold_in(rng, li) if impl.needs_rng else None
+            if impl.has_state:
+                tvals, st = impl.apply_stateful(
+                    lparams, state[lp.name], bvals, train, lrng)
+                new_state[lp.name] = st
+            else:
+                tvals = impl.apply(lparams, bvals, train, lrng)
+            for t, v in zip(tops, tvals):
+                blobs[t] = v
+        return blobs, new_state
+
+    def total_loss(self, blobs):
+        """Weighted sum of loss tops (reference net.cpp ForwardFromTo loss
+        accumulation via loss_weight)."""
+        total = jnp.zeros((), jnp.float32)
+        for lp, impl, bottoms, tops in self.layers:
+            for t, w in zip(tops, self.loss_weights[lp.name]):
+                if w:
+                    total = total + w * jnp.sum(blobs[t]).astype(jnp.float32)
+        return total
+
+    def loss_fn(self, params, state, batch, rng=None):
+        blobs, new_state = self.apply(params, state, batch, rng=rng)
+        return self.total_loss(blobs), (blobs, new_state)
+
+    # -- weight io ---------------------------------------------------------
+    def params_to_netproto(self, params, state=None):
+        """Emit a NetParameter with blobs filled — .caffemodel-compatible
+        (reference net.cpp ToProto :911)."""
+        out = Message("NetParameter", name=self.name or "net")
+        for lp, impl, bottoms, tops in self.layers:
+            olp = lp.copy()
+            olp.clear("blobs")
+            merged = []
+            if lp.name in params:
+                merged += list(params[lp.name])
+            if state and lp.name in state:
+                merged += list(state[lp.name])
+            for arr in merged:
+                olp.blobs.append(array_to_blob(np.asarray(arr)))
+            out.layer.append(olp)
+        return out
+
+    def load_netproto(self, net_proto, params, state=None, strict=False):
+        """Copy weights from a NetParameter by layer name (reference
+        net.cpp CopyTrainedLayersFrom :805): shapes must match; layers
+        absent from either side are skipped unless strict."""
+        net_proto = upgrade_v1(net_proto)
+        by_name = {l.name: l for l in net_proto.layer}
+        params = {k: list(v) for k, v in params.items()}
+        state = {k: list(v) for k, v in (state or {}).items()}
+        for lp, impl, bottoms, tops in self.layers:
+            src = by_name.get(lp.name)
+            if src is None or not src.blobs:
+                if strict and lp.name in params:
+                    raise ValueError(f"no weights for layer {lp.name!r}")
+                continue
+            tgt = list(params.get(lp.name, []))
+            n_p = len(tgt)
+            sblobs = list(src.blobs)
+            for i, bp in enumerate(sblobs):
+                arr = blob_to_array(bp)
+                if i < n_p:
+                    if arr.size != tgt[i].size:
+                        raise ValueError(
+                            f"layer {lp.name!r} blob {i}: size mismatch "
+                            f"{arr.shape} vs {tgt[i].shape}")
+                    tgt[i] = jnp.asarray(arr.reshape(tgt[i].shape),
+                                         self.dtype)
+                elif lp.name in state and i - n_p < len(state[lp.name]):
+                    j = i - n_p
+                    state[lp.name][j] = jnp.asarray(
+                        arr.reshape(state[lp.name][j].shape), self.dtype)
+            if tgt:
+                params[lp.name] = tgt
+        return params, state
+
+
+def blob_to_array(bp):
+    if bp.has("shape"):
+        shape = [int(d) for d in bp.shape.dim]
+    else:
+        shape = [d for d in (bp.num, bp.channels, bp.height, bp.width)]
+        # legacy 4D: strip leading 1s only if count matches without them
+    data = bp.double_data if bp.double_data else bp.data
+    arr = np.asarray(list(data), np.float32)
+    if shape and int(np.prod(shape)) == arr.size:
+        arr = arr.reshape(shape)
+    return arr
+
+
+def array_to_blob(arr):
+    bp = Message("BlobProto")
+    bp.ensure("shape").dim.extend(int(d) for d in arr.shape)
+    bp.data.extend_raw(np.asarray(arr, np.float32).ravel().tolist())
+    return bp

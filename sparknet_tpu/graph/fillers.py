@@ -1,0 +1,63 @@
+"""Weight fillers with Caffe semantics (reference include/caffe/filler.hpp).
+
+Fan computation follows Caffe's blob convention: for a blob of shape
+(num, ...) — fan_in = count/num, fan_out = count/shape[1]
+(filler.hpp:150-151) — which for an OIHW conv weight gives
+fan_in = I*kh*kw, fan_out = O*kh*kw (under group conv, I is already C/g).
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+def _fans(shape):
+    count = int(np.prod(shape))
+    fan_in = count // shape[0] if len(shape) > 0 else count
+    fan_out = count // shape[1] if len(shape) > 1 else count
+    return fan_in, fan_out
+
+
+def _n_for(variance_norm, shape):
+    fan_in, fan_out = _fans(shape)
+    if variance_norm == 1:  # FAN_OUT
+        return fan_out
+    if variance_norm == 2:  # AVERAGE
+        return (fan_in + fan_out) / 2.0
+    return fan_in
+
+
+def fill(rng, shape, filler, dtype=jnp.float32):
+    """Materialize one blob from a FillerParameter (None -> constant 0)."""
+    if filler is None:
+        return jnp.zeros(shape, dtype)
+    ftype = filler.type
+    if ftype == "constant":
+        return jnp.full(shape, filler.value, dtype)
+    if ftype == "uniform":
+        return jax.random.uniform(rng, shape, dtype, filler.min, filler.max)
+    if ftype == "gaussian":
+        # sparse gaussian (filler.hpp GaussianFiller) not needed for parity
+        return filler.mean + filler.std * jax.random.normal(rng, shape, dtype)
+    if ftype == "xavier":
+        scale = float(np.sqrt(3.0 / _n_for(filler.variance_norm, shape)))
+        return jax.random.uniform(rng, shape, dtype, -scale, scale)
+    if ftype == "msra":
+        std = float(np.sqrt(2.0 / _n_for(filler.variance_norm, shape)))
+        return std * jax.random.normal(rng, shape, dtype)
+    if ftype == "positive_unitball":
+        x = jax.random.uniform(rng, shape, dtype)
+        flat = x.reshape(shape[0], -1)
+        flat = flat / jnp.sum(flat, axis=1, keepdims=True)
+        return flat.reshape(shape)
+    if ftype == "bilinear":
+        # upsampling kernel for deconv (filler.hpp BilinearFiller)
+        if len(shape) != 4 or shape[2] != shape[3]:
+            raise ValueError("bilinear filler needs square 4D blob")
+        k = shape[3]
+        f = int(np.ceil(k / 2.0))
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        og = np.ogrid[:k, :k]
+        kernel = (1 - abs(og[0] / f - c)) * (1 - abs(og[1] / f - c))
+        return jnp.broadcast_to(jnp.asarray(kernel, dtype), shape)
+    raise ValueError(f"unknown filler type {ftype!r}")

@@ -1,0 +1,77 @@
+"""Fully-connected layers — the MXU-hot matmuls.
+
+InnerProduct matches reference inner_product_layer.cpp: bottom flattened from
+``axis`` onward, weight blob (num_output, K), y = x @ W^T + b. Embed matches
+embed_layer.cpp: one-hot indices -> row gather, weight (input_dim, num_output).
+"""
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..graph.registry import Layer, register
+from .convolution import _param_mults
+
+
+@register
+class InnerProduct(Layer):
+    type_name = "InnerProduct"
+
+    def __init__(self, lp, bottom_shapes, phase):
+        super().__init__(lp, bottom_shapes, phase)
+        p = lp.inner_product_param
+        self.p = p
+        self.num_output = int(p.num_output)
+        self.bias_term = bool(p.bias_term)
+        self.axis = self.canonical_axis(p.axis)
+        shape = bottom_shapes[0]
+        self.outer = int(np.prod(shape[:self.axis], dtype=np.int64))
+        self.K = int(np.prod(shape[self.axis:], dtype=np.int64))
+
+    def param_shapes(self):
+        mults = _param_mults(self.lp, 2 if self.bias_term else 1)
+        out = [((self.num_output, self.K), self.p.weight_filler, *mults[0])]
+        if self.bias_term:
+            out.append(((self.num_output,), self.p.bias_filler, *mults[1]))
+        return out
+
+    def out_shapes(self):
+        return [tuple(self.bottom_shapes[0][:self.axis]) + (self.num_output,)]
+
+    def apply(self, params, bottoms, train, rng):
+        x = bottoms[0]
+        w = params[0].astype(x.dtype)
+        y = x.reshape(self.outer, self.K) @ w.T
+        if self.bias_term:
+            y = y + params[1].astype(x.dtype)
+        return [y.reshape(self.out_shapes()[0])]
+
+
+@register
+class Embed(Layer):
+    type_name = "Embed"
+
+    def __init__(self, lp, bottom_shapes, phase):
+        super().__init__(lp, bottom_shapes, phase)
+        p = lp.embed_param
+        self.p = p
+        self.num_output = int(p.num_output)
+        self.input_dim = int(p.input_dim)
+        self.bias_term = bool(p.bias_term)
+
+    def param_shapes(self):
+        mults = _param_mults(self.lp, 2 if self.bias_term else 1)
+        out = [((self.input_dim, self.num_output), self.p.weight_filler,
+                *mults[0])]
+        if self.bias_term:
+            out.append(((self.num_output,), self.p.bias_filler, *mults[1]))
+        return out
+
+    def out_shapes(self):
+        return [tuple(self.bottom_shapes[0]) + (self.num_output,)]
+
+    def apply(self, params, bottoms, train, rng):
+        idx = bottoms[0].astype(jnp.int32)
+        y = jnp.take(params[0], idx, axis=0)
+        if self.bias_term:
+            y = y + params[1]
+        return [y]

@@ -1,0 +1,57 @@
+"""Local Response Normalization, both norm regions.
+
+Reference lrn_layer.cpp:
+  ACROSS_CHANNELS (:108-151): scale = k + (alpha/n) * sum_{window n over C} x^2,
+    zero-padded at the channel edges; out = x * scale^-beta.
+  WITHIN_CHANNEL (:28-62, :155-162): out = x * (1 + alpha * s)^-beta where s is
+    an AVE-pool of x^2 with kernel local_size, stride 1, pad (n-1)/2 — using
+    Caffe AVE pooling's pad-inclusive divisor, which this reuses from ops.pooling.
+"""
+
+from jax import lax
+import jax.numpy as jnp
+
+from ..graph.registry import Layer, register
+from .pooling import ave_pool, caffe_pool_geometry
+from ..proto.message import Message
+
+
+@register
+class LRN(Layer):
+    type_name = "LRN"
+
+    def __init__(self, lp, bottom_shapes, phase):
+        super().__init__(lp, bottom_shapes, phase)
+        p = lp.lrn_param
+        self.size = int(p.local_size)
+        self.alpha = float(p.alpha)
+        self.beta = float(p.beta)
+        self.k = float(p.k)
+        self.within = int(p.norm_region) == 1
+        if self.within:
+            pp = Message("PoolingParameter", pool="AVE",
+                         kernel_size=self.size, stride=1,
+                         pad=(self.size - 1) // 2)
+            n, c, h, w = bottom_shapes[0]
+            self.pool_geom = caffe_pool_geometry(pp, h, w)
+
+    def out_shapes(self):
+        return [self.bottom_shapes[0]]
+
+    def apply(self, params, bottoms, train, rng):
+        x = bottoms[0]
+        if self.within:
+            kernel, stride, pad, out = self.pool_geom
+            s = ave_pool(x * x, kernel, stride, pad, out)
+            scale = 1.0 + self.alpha * s
+        else:
+            half = (self.size - 1) // 2
+            sq = x * x
+            ssum = lax.reduce_window(
+                sq, 0.0, lax.add,
+                window_dimensions=(1, self.size, 1, 1),
+                window_strides=(1, 1, 1, 1),
+                padding=((0, 0), (half, self.size - 1 - half), (0, 0), (0, 0)),
+            )
+            scale = self.k + (self.alpha / self.size) * ssum
+        return [x * scale ** (-self.beta)]

@@ -1,0 +1,98 @@
+"""Normalization layers: BatchNorm (stateful) and MVN.
+
+BatchNorm matches reference batch_norm_layer.cpp: three non-learnable blobs
+[running_mean*s, running_var*s, s] where s is the accumulated scale factor;
+use_global_stats defaults to (phase == TEST) (:14-16); TRAIN normalizes by
+batch statistics (biased var) and updates the moving blobs with
+moving_average_fraction and the m/(m-1) bias correction. Running stats are
+framework *state*, threaded functionally through the compiled step rather
+than mutated in place.
+
+MVN (mvn_layer.cpp) normalizes each sample (per channel, or across channels)
+to zero mean and, optionally, unit variance with divisor (std + eps).
+"""
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..graph.registry import Layer, register
+
+
+@register
+class BatchNorm(Layer):
+    type_name = "BatchNorm"
+    has_state = True
+
+    def __init__(self, lp, bottom_shapes, phase):
+        super().__init__(lp, bottom_shapes, phase)
+        p = lp.batch_norm_param
+        self.eps = float(p.eps)
+        self.maf = float(p.moving_average_fraction)
+        if p.has("use_global_stats"):
+            self.use_global = bool(p.use_global_stats)
+        else:
+            self.use_global = (phase == 1)  # TEST
+        self.channels = bottom_shapes[0][1]
+
+    def state_shapes(self):
+        c = self.channels
+        return [((c,), 0.0), ((c,), 0.0), ((1,), 0.0)]
+
+    def out_shapes(self):
+        return [self.bottom_shapes[0]]
+
+    def apply_stateful(self, params, state, bottoms, train, rng):
+        x = bottoms[0]
+        mean_b, var_b, scale_b = state
+        axes = (0,) + tuple(range(2, x.ndim))
+        if self.use_global or not train:
+            s = scale_b[0]
+            factor = jnp.where(s == 0, 0.0, 1.0 / jnp.where(s == 0, 1.0, s))
+            mean = mean_b * factor
+            var = var_b * factor
+            new_state = state
+        else:
+            mean = jnp.mean(x, axis=axes)
+            var = jnp.mean((x - _bcast(mean, x)) ** 2, axis=axes)
+            m = x.size // self.channels
+            correction = m / (m - 1) if m > 1 else 1.0
+            new_state = [
+                self.maf * mean_b + mean,
+                self.maf * var_b + correction * var,
+                self.maf * scale_b + 1.0,
+            ]
+        inv = 1.0 / jnp.sqrt(var + self.eps)
+        y = (x - _bcast(mean, x)) * _bcast(inv, x)
+        return [y], new_state
+
+
+def _bcast(v, x):
+    shape = [1] * x.ndim
+    shape[1] = v.shape[0]
+    return v.reshape(shape)
+
+
+@register
+class MVN(Layer):
+    type_name = "MVN"
+
+    def __init__(self, lp, bottom_shapes, phase):
+        super().__init__(lp, bottom_shapes, phase)
+        p = lp.mvn_param
+        self.normalize_variance = bool(p.normalize_variance)
+        self.across_channels = bool(p.across_channels)
+        self.eps = float(p.eps)
+
+    def out_shapes(self):
+        return [self.bottom_shapes[0]]
+
+    def apply(self, params, bottoms, train, rng):
+        x = bottoms[0]
+        axes = tuple(range(1, x.ndim)) if self.across_channels \
+            else tuple(range(2, x.ndim))
+        mean = jnp.mean(x, axis=axes, keepdims=True)
+        y = x - mean
+        if self.normalize_variance:
+            std = jnp.sqrt(jnp.mean(y * y, axis=axes, keepdims=True))
+            y = y / (std + self.eps)
+        return [y]

@@ -97,7 +97,9 @@ class Message:
             self._fields[name] = lst  # cached so appends stick
             return lst
         if schema.is_message(ftype):
-            return None
+            # protobuf semantics: reading an unset sub-message yields the
+            # default instance (uncached, so has() remains False)
+            return Message(ftype)
         if default is not None:
             return default
         return schema.zero_value(ftype)

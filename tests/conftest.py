@@ -8,12 +8,18 @@ ImageNetLoaderSpec, was @ignore'd; see SURVEY.md section 4).
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+# The environment's sitecustomize force-registers the axon TPU platform and
+# overrides jax_platforms; pin back to CPU for hermetic multi-device tests.
+jax.config.update("jax_platforms", "cpu")
 
 REFERENCE = "/root/reference"
 

@@ -1,0 +1,251 @@
+"""Graph-compiler tests: phase filtering, in-place SSA, param sharing,
+weight IO, and whole-net builds from stock reference prototxts (the
+capability checks mirroring reference net.cpp behaviors and LayerSpec.scala).
+"""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from sparknet_tpu import proto
+from sparknet_tpu.proto import Message
+from sparknet_tpu.graph import CompiledNet, filter_net, upgrade_v1, TRAIN, TEST
+
+REF = "/root/reference/caffe"
+CIFAR_SHAPES = {"data": (4, 3, 32, 32), "label": (4,)}
+
+
+def load_cifar_net():
+    return proto.load_prototxt(
+        f"{REF}/examples/cifar10/cifar10_full_train_test.prototxt",
+        "NetParameter")
+
+
+def tiny_mlp(loss_weight=None):
+    net = Message("NetParameter", name="tiny")
+    net.add("layer", name="d", type="JavaData", top=["data"],
+            java_data_param=dict(shape=dict(dim=[4, 6])))
+    net.add("layer", name="l", type="JavaData", top=["label"],
+            java_data_param=dict(shape=dict(dim=[4])))
+    net.add("layer", name="fc1", type="InnerProduct", bottom=["data"],
+            top=["fc1"], inner_product_param=dict(
+                num_output=5, weight_filler=dict(type="xavier")))
+    net.add("layer", name="relu1", type="ReLU", bottom=["fc1"], top=["fc1"])
+    net.add("layer", name="fc2", type="InnerProduct", bottom=["fc1"],
+            top=["fc2"], inner_product_param=dict(
+                num_output=3, weight_filler=dict(type="xavier")))
+    loss = net.add("layer", name="loss", type="SoftmaxWithLoss",
+                   bottom=["fc2", "label"], top=["loss"])
+    if loss_weight is not None:
+        loss.loss_weight.append(loss_weight)
+    return net
+
+
+class TestPhaseFiltering:
+    def test_cifar_phases(self):
+        net = load_cifar_net()
+        tr = filter_net(net, TRAIN)
+        te = filter_net(net, TEST)
+        tr_names = [l.name for l in tr.layer]
+        te_names = [l.name for l in te.layer]
+        assert tr_names.count("cifar") == 1  # one data layer per phase
+        assert te_names.count("cifar") == 1
+        assert "accuracy" not in tr_names
+        assert "accuracy" in te_names
+
+    def test_exclude_rule(self):
+        net = tiny_mlp()
+        net.layer[2].add("exclude", phase="TEST")
+        te = filter_net(net, TEST)
+        assert "fc1" not in [l.name for l in te.layer]
+
+    def test_stage_rules(self):
+        net = tiny_mlp()
+        net.layer[2].add("include", stage=["deploy"])
+        assert "fc1" not in [l.name for l in filter_net(net, TRAIN).layer]
+        assert "fc1" in [l.name for l in
+                         filter_net(net, TRAIN, stages=("deploy",)).layer]
+
+
+class TestBuild:
+    def test_inplace_ssa(self):
+        net = CompiledNet(tiny_mlp(), TRAIN)
+        params, state = net.init(jax.random.PRNGKey(0))
+        batch = {"data": jnp.ones((4, 6)), "label": jnp.zeros((4,), jnp.int32)}
+        blobs, _ = net.apply(params, state, batch)
+        # relu applied in place onto fc1's blob name
+        assert float(blobs["fc1"].min()) >= 0.0
+        assert net.output_blobs == ["loss"]
+
+    def test_undefined_bottom_raises(self):
+        net = tiny_mlp()
+        net.layer[2].bottom[0] = "nonexistent"
+        with pytest.raises(ValueError, match="undefined"):
+            CompiledNet(net, TRAIN)
+
+    def test_feed_shapes_required_for_db_layers(self):
+        net = load_cifar_net()
+        with pytest.raises(ValueError, match="feed_shapes"):
+            CompiledNet(net, TRAIN)
+
+    def test_cifar_full_shapes(self):
+        net = CompiledNet(load_cifar_net(), TRAIN, feed_shapes=CIFAR_SHAPES)
+        # caffe's published blob progression for cifar10_full
+        assert net.blob_shapes["conv1"] == (4, 32, 32, 32)
+        assert net.blob_shapes["pool1"] == (4, 32, 16, 16)
+        assert net.blob_shapes["norm1"] == (4, 32, 16, 16)
+        assert net.blob_shapes["conv2"] == (4, 32, 16, 16)
+        assert net.blob_shapes["pool2"] == (4, 32, 8, 8)
+        assert net.blob_shapes["conv3"] == (4, 64, 8, 8)
+        assert net.blob_shapes["pool3"] == (4, 64, 4, 4)
+        assert net.blob_shapes["ip1"] == (4, 10)
+
+    def test_caffenet_param_count(self):
+        npm = proto.load_prototxt(
+            f"{REF}/models/bvlc_reference_caffenet/train_val.prototxt",
+            "NetParameter")
+        net = CompiledNet(npm, TRAIN,
+                          feed_shapes={"data": (2, 3, 227, 227),
+                                       "label": (2,)})
+        total = sum(int(v.size) for _, (s, f, lr, dc) in
+                    sorted(net.param_meta.items())
+                    for v in [np.zeros(s)])
+        assert total == 60965224  # canonical AlexNet/CaffeNet 61M
+
+    def test_googlenet_builds_with_three_losses(self):
+        npm = proto.load_prototxt(
+            f"{REF}/models/bvlc_googlenet/train_val.prototxt", "NetParameter")
+        net = CompiledNet(npm, TRAIN,
+                          feed_shapes={"data": (2, 3, 224, 224),
+                                       "label": (2,)})
+        assert sorted(net.output_blobs) == [
+            "loss1/loss1", "loss2/loss1", "loss3/loss3"]
+        # aux losses weighted 0.3 (train_val.prototxt)
+        w = {l.name: ws for (l, i, b, t), ws in
+             zip(net.layers, [net.loss_weights[l.name]
+                              for l, _, _, _ in net.layers])}
+        assert w["loss1/loss"] == [pytest.approx(0.3)]
+        assert w["loss3/loss3"] == [1.0]
+
+    def test_deploy_net_inputs(self):
+        npm = proto.load_prototxt(
+            f"{REF}/models/bvlc_googlenet/deploy.prototxt", "NetParameter")
+        net = CompiledNet(npm, TEST)
+        assert net.net_inputs == ["data"]
+        assert net.blob_shapes["data"] == (10, 3, 224, 224)
+        assert net.output_blobs == ["prob"]
+
+
+class TestForward:
+    def test_uniform_logits_loss(self):
+        net = CompiledNet(load_cifar_net(), TRAIN, feed_shapes=CIFAR_SHAPES)
+        params, state = net.init(jax.random.PRNGKey(0))
+        batch = {"data": jnp.zeros((4, 3, 32, 32)),
+                 "label": jnp.zeros((4,), jnp.int32)}
+        loss, (blobs, _) = net.loss_fn(params, state, batch,
+                                       rng=jax.random.PRNGKey(1))
+        # gaussian-initialized tiny weights -> near-uniform logits
+        assert abs(float(loss) - np.log(10)) < 0.1
+
+    def test_loss_weight_scaling(self):
+        net1 = CompiledNet(tiny_mlp(), TRAIN)
+        net2 = CompiledNet(tiny_mlp(loss_weight=2.5), TRAIN)
+        params, state = net1.init(jax.random.PRNGKey(0))
+        batch = {"data": jnp.ones((4, 6)),
+                 "label": jnp.zeros((4,), jnp.int32)}
+        l1, _ = net1.loss_fn(params, state, batch)
+        l2, _ = net2.loss_fn(params, state, batch)
+        np.testing.assert_allclose(float(l2), 2.5 * float(l1), rtol=1e-6)
+
+    def test_grad_flows_to_all_params(self):
+        net = CompiledNet(load_cifar_net(), TRAIN, feed_shapes=CIFAR_SHAPES)
+        params, state = net.init(jax.random.PRNGKey(0))
+        batch = {"data": jnp.asarray(
+            np.random.RandomState(0).randn(4, 3, 32, 32), jnp.float32),
+            "label": jnp.asarray([0, 1, 2, 3])}
+        g = jax.grad(lambda p: net.loss_fn(p, state, batch,
+                                           rng=jax.random.PRNGKey(1))[0])(params)
+        for lname, blobs in g.items():
+            for i, b in enumerate(blobs):
+                assert float(jnp.abs(b).max()) > 0, f"{lname}[{i}] zero grad"
+
+    def test_train_vs_test_determinism(self):
+        net = CompiledNet(load_cifar_net(), TEST, feed_shapes=CIFAR_SHAPES)
+        params, state = net.init(jax.random.PRNGKey(0))
+        batch = {"data": jnp.ones((4, 3, 32, 32)),
+                 "label": jnp.zeros((4,), jnp.int32)}
+        b1, _ = net.apply(params, state, batch)
+        b2, _ = net.apply(params, state, batch)
+        np.testing.assert_array_equal(b1["accuracy"], b2["accuracy"])
+
+
+class TestParamSharing:
+    def test_shared_by_name(self):
+        net = Message("NetParameter")
+        net.add("layer", name="d", type="JavaData", top=["data"],
+                java_data_param=dict(shape=dict(dim=[2, 4])))
+        l1 = net.add("layer", name="a", type="InnerProduct", bottom=["data"],
+                     top=["a"], inner_product_param=dict(
+                         num_output=4, bias_term=False,
+                         weight_filler=dict(type="xavier")))
+        l1.add("param", name="w_shared")
+        l2 = net.add("layer", name="b", type="InnerProduct", bottom=["a"],
+                     top=["b"], inner_product_param=dict(
+                         num_output=4, bias_term=False))
+        l2.add("param", name="w_shared")
+        cn = CompiledNet(net, TRAIN)
+        params, state = cn.init(jax.random.PRNGKey(0))
+        assert "a" in params and "b" not in params
+        pa = cn.resolve_params(params, "a")
+        pb = cn.resolve_params(params, "b")
+        assert pa[0] is pb[0]
+
+
+class TestWeightIO:
+    def test_netproto_roundtrip(self):
+        cn = CompiledNet(tiny_mlp(), TRAIN)
+        params, state = cn.init(jax.random.PRNGKey(42))
+        npz = cn.params_to_netproto(params, state)
+        # re-init differently, then load back
+        params2, state2 = cn.init(jax.random.PRNGKey(7))
+        loaded, _ = cn.load_netproto(npz, params2, state2)
+        for lname in params:
+            for a, b in zip(params[lname], loaded[lname]):
+                np.testing.assert_allclose(a, b, rtol=1e-6)
+
+    def test_load_via_wire_format(self, tmp_path):
+        cn = CompiledNet(tiny_mlp(), TRAIN)
+        params, state = cn.init(jax.random.PRNGKey(42))
+        npz = cn.params_to_netproto(params, state)
+        path = str(tmp_path / "model.caffemodel")
+        proto.save_binaryproto(npz, path)
+        re = proto.load_binaryproto(path, "NetParameter")
+        params2, _ = cn.load_netproto(re, *cn.init(jax.random.PRNGKey(7)))
+        np.testing.assert_allclose(params["fc1"][0], params2["fc1"][0],
+                                   rtol=1e-6)
+
+    def test_size_mismatch_raises(self):
+        cn = CompiledNet(tiny_mlp(), TRAIN)
+        params, state = cn.init(jax.random.PRNGKey(0))
+        bad = cn.params_to_netproto(params)
+        bad.layer[2].blobs[0].ensure("shape").dim[0] = 999
+        bad.layer[2].blobs[0].data.append(0.0)
+        with pytest.raises(ValueError, match="mismatch"):
+            cn.load_netproto(bad, params, state)
+
+
+class TestV1Upgrade:
+    def test_v1_layers_upgrade(self):
+        net = Message("NetParameter", name="old")
+        v1 = net.add("layers", name="ip", type="INNER_PRODUCT",
+                     bottom=["data"], top=["out"],
+                     inner_product_param=dict(num_output=3))
+        v1.blobs_lr.extend([1.0, 2.0])
+        v1.weight_decay.extend([1.0, 0.0])
+        up = upgrade_v1(net)
+        assert up.layer[0].type == "InnerProduct"
+        assert up.layer[0].param[0].lr_mult == 1.0
+        assert up.layer[0].param[1].lr_mult == 2.0
+        assert up.layer[0].param[1].decay_mult == 0.0
+        assert not up.layers

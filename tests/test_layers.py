@@ -1,0 +1,586 @@
+"""Per-layer forward-correctness + numerical gradient checks.
+
+The TPU-native analog of the reference's GradientChecker harness
+(test_gradient_check_util.hpp:19): every differentiable layer's jax.grad is
+compared against central finite differences, and forwards are checked against
+straightforward numpy re-computations of the Caffe formulas (pooling's
+ceil-mode/pad-divisor corner cases hand-derived from pooling_layer.cpp).
+"""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from sparknet_tpu.proto import Message
+from sparknet_tpu.graph.registry import get as get_layer
+
+RNG = np.random.RandomState(0)
+
+
+def make_layer(type_name, bottom_shapes, phase=0, **layer_fields):
+    lp = Message("LayerParameter", name="t", type=type_name, **layer_fields)
+    cls = get_layer(type_name)
+    return cls(lp, bottom_shapes, phase), lp
+
+
+def init_params(layer, seed=0):
+    rng = jax.random.PRNGKey(seed)
+    out = []
+    for i, (shape, filler, lr, dc) in enumerate(layer.param_shapes()):
+        k = jax.random.fold_in(rng, i)
+        out.append(0.1 * jax.random.normal(k, shape))
+    return out
+
+
+def numeric_grad(f, x, step=1e-2):
+    """Central-difference gradient of scalar f at x (mirrors the reference
+    checker's two-sided estimate, test_gradient_check_util.hpp:160-171)."""
+    x = np.asarray(x, np.float64)
+    g = np.zeros_like(x)
+    flat = x.ravel()
+    gflat = g.ravel()
+    for i in range(flat.size):
+        old = flat[i]
+        flat[i] = old + step
+        fp = float(f(jnp.asarray(x, jnp.float32)))
+        flat[i] = old - step
+        fm = float(f(jnp.asarray(x, jnp.float32)))
+        flat[i] = old
+        gflat[i] = (fp - fm) / (2 * step)
+    return g
+
+
+def check_grad(f, x, step=1e-2, tol=2e-2):
+    analytic = np.asarray(jax.grad(lambda v: f(v))(jnp.asarray(x, jnp.float32)))
+    numeric = numeric_grad(f, x, step)
+    scale = max(1.0, np.abs(numeric).max())
+    np.testing.assert_allclose(analytic, numeric, atol=tol * scale,
+                               err_msg="analytic vs numeric gradient")
+
+
+class TestConvolution:
+    def test_forward_matches_direct(self):
+        layer, _ = make_layer(
+            "Convolution", [(2, 3, 5, 5)],
+            convolution_param=dict(num_output=4, kernel_size=[3], stride=[1],
+                                   pad=[1]))
+        params = init_params(layer)
+        x = jnp.asarray(RNG.randn(2, 3, 5, 5), jnp.float32)
+        (y,) = layer.apply(params, [x], False, None)
+        assert y.shape == (2, 4, 5, 5)
+        # direct computation at one output position
+        w, b = np.asarray(params[0]), np.asarray(params[1])
+        xp = np.pad(np.asarray(x), ((0, 0), (0, 0), (1, 1), (1, 1)))
+        want = (xp[1, :, 2:5, 1:4] * w[3]).sum() + b[3]
+        np.testing.assert_allclose(y[1, 3, 2, 1], want, rtol=2e-5)
+
+    def test_grouped(self):
+        layer, _ = make_layer(
+            "Convolution", [(1, 4, 4, 4)],
+            convolution_param=dict(num_output=6, kernel_size=[3], group=2))
+        params = init_params(layer)
+        assert params[0].shape == (6, 2, 3, 3)
+        x = jnp.asarray(RNG.randn(1, 4, 4, 4), jnp.float32)
+        (y,) = layer.apply(params, [x], False, None)
+        assert y.shape == (1, 6, 2, 2)
+        # group 0 outputs depend only on channels 0-1
+        x2 = x.at[:, 2:].set(0.0)
+        (y2,) = layer.apply(params, [x2], False, None)
+        np.testing.assert_allclose(y[:, :3], y2[:, :3], rtol=1e-5)
+
+    def test_rect_kernel_stride(self):
+        layer, _ = make_layer(
+            "Convolution", [(1, 2, 8, 9)],
+            convolution_param=dict(num_output=3, kernel_h=3, kernel_w=2,
+                                   stride_h=2, stride_w=3, pad_h=1, pad_w=0))
+        assert layer.out_shapes() == [(1, 3, 4, 3)]
+
+    def test_gradcheck(self):
+        layer, _ = make_layer(
+            "Convolution", [(1, 2, 4, 4)],
+            convolution_param=dict(num_output=2, kernel_size=[3], pad=[1]))
+        params = init_params(layer)
+        x = np.asarray(0.5 * RNG.randn(1, 2, 4, 4), np.float32)
+        check_grad(lambda v: layer.apply(params, [v], False, None)[0].sum(), x)
+        check_grad(lambda w: layer.apply([w, params[1]],
+                                         [jnp.asarray(x)], False, None)[0].sum(),
+                   np.asarray(params[0]))
+
+
+class TestDeconvolution:
+    def test_shape_and_inverse_of_conv(self):
+        layer, _ = make_layer(
+            "Deconvolution", [(1, 3, 4, 4)],
+            convolution_param=dict(num_output=2, kernel_size=[4], stride=[2],
+                                   pad=[1]))
+        assert layer.out_shapes() == [(1, 2, 8, 8)]
+        params = init_params(layer)
+        x = jnp.asarray(RNG.randn(1, 3, 4, 4), jnp.float32)
+        (y,) = layer.apply(params, [x], False, None)
+        assert y.shape == (1, 2, 8, 8)
+
+    def test_gradcheck(self):
+        layer, _ = make_layer(
+            "Deconvolution", [(1, 2, 3, 3)],
+            convolution_param=dict(num_output=2, kernel_size=[2], stride=[2]))
+        params = init_params(layer)
+        x = np.asarray(0.5 * RNG.randn(1, 2, 3, 3), np.float32)
+        check_grad(lambda v: layer.apply(params, [v], False, None)[0].sum(), x)
+
+
+class TestPooling:
+    def test_ceil_mode_sizing(self):
+        # CIFAR pool1: 32x32, k3 s2 -> ceil((32-3)/2)+1 = 16
+        layer, _ = make_layer("Pooling", [(1, 1, 32, 32)],
+                              pooling_param=dict(pool="MAX", kernel_size=3,
+                                                 stride=2))
+        assert layer.out_shapes() == [(1, 1, 16, 16)]
+        # AlexNet pool5: 13x13 k3 s2 -> ceil(10/2)+1 = 6
+        layer, _ = make_layer("Pooling", [(1, 1, 13, 13)],
+                              pooling_param=dict(pool="MAX", kernel_size=3,
+                                                 stride=2))
+        assert layer.out_shapes() == [(1, 1, 6, 6)]
+
+    def test_pad_clip_rule(self):
+        # in=4, k=3, s=2, p=1: ceil((4+2-3)/2)+1 = 3; (3-1)*2=4 < 4+1 -> keep 3
+        layer, _ = make_layer("Pooling", [(1, 1, 4, 4)],
+                              pooling_param=dict(pool="AVE", kernel_size=3,
+                                                 stride=2, pad=1))
+        assert layer.out_shapes() == [(1, 1, 3, 3)]
+        # in=2, k=2, s=2, p=1: ceil((2+2-2)/2)+1 = 2; (2-1)*2=2 >= 2+1? no -> 2
+        layer, _ = make_layer("Pooling", [(1, 1, 2, 2)],
+                              pooling_param=dict(pool="AVE", kernel_size=2,
+                                                 stride=2, pad=1))
+        assert layer.out_shapes() == [(1, 1, 2, 2)]
+
+    def test_max_ignores_padding(self):
+        layer, _ = make_layer("Pooling", [(1, 1, 2, 2)],
+                              pooling_param=dict(pool="MAX", kernel_size=2,
+                                                 stride=2, pad=1))
+        x = -jnp.ones((1, 1, 2, 2))  # all negative; pad must not win
+        (y,) = layer.apply([], [x], False, None)
+        assert float(y.max()) == -1.0
+
+    def test_ave_divisor_includes_pad(self):
+        # caffe AVE: divisor = raw window clipped to in+pad
+        layer, _ = make_layer("Pooling", [(1, 1, 3, 3)],
+                              pooling_param=dict(pool="AVE", kernel_size=3,
+                                                 stride=2, pad=1))
+        x = jnp.ones((1, 1, 3, 3))
+        (y,) = layer.apply([], [x], False, None)
+        # out position (0,0): window rows/cols [-1,2): 2 real rows of 3-col
+        # window... divisor = (min(-1+3, 3+1) - (-1))^2 = 3^2 = 9, sum = 4
+        np.testing.assert_allclose(y[0, 0, 0, 0], 4.0 / 9.0, rtol=1e-6)
+        # center (1,1): window [1,4) clip->[1,3) real sum 4; divisor:
+        # (min(1+3,4)-1)=3 per axis -> 9
+        np.testing.assert_allclose(y[0, 0, 1, 1], 4.0 / 9.0, rtol=1e-6)
+
+    def test_ave_matches_numpy_nopad(self):
+        layer, _ = make_layer("Pooling", [(2, 3, 6, 6)],
+                              pooling_param=dict(pool="AVE", kernel_size=2,
+                                                 stride=2))
+        x = RNG.randn(2, 3, 6, 6).astype(np.float32)
+        (y,) = layer.apply([], [jnp.asarray(x)], False, None)
+        want = x.reshape(2, 3, 3, 2, 3, 2).mean(axis=(3, 5))
+        np.testing.assert_allclose(y, want, rtol=1e-5)
+
+    def test_global_pooling(self):
+        layer, _ = make_layer("Pooling", [(2, 5, 7, 7)],
+                              pooling_param=dict(pool="AVE",
+                                                 global_pooling=True))
+        assert layer.out_shapes() == [(2, 5, 1, 1)]
+        x = RNG.randn(2, 5, 7, 7).astype(np.float32)
+        (y,) = layer.apply([], [jnp.asarray(x)], False, None)
+        np.testing.assert_allclose(y[:, :, 0, 0], x.mean(axis=(2, 3)),
+                                   rtol=1e-5)
+
+    def test_stochastic_train_and_test(self):
+        layer, _ = make_layer("Pooling", [(1, 1, 4, 4)],
+                              pooling_param=dict(pool="STOCHASTIC",
+                                                 kernel_size=2, stride=2))
+        x = jnp.abs(jnp.asarray(RNG.randn(1, 1, 4, 4), jnp.float32)) + 0.1
+        (y,) = layer.apply([], [x], True, jax.random.PRNGKey(0))
+        # every sampled value must be one of the window members
+        xa = np.asarray(x).reshape(2, 2, 2, 2)
+        for i in range(2):
+            for j in range(2):
+                win = np.asarray(x)[0, 0, 2*i:2*i+2, 2*j:2*j+2].ravel()
+                assert float(y[0, 0, i, j]) in [float(v) for v in win]
+        (yt,) = layer.apply([], [x], False, None)
+        xs = np.asarray(x)
+        for i in range(2):
+            for j in range(2):
+                win = xs[0, 0, 2*i:2*i+2, 2*j:2*j+2].ravel()
+                np.testing.assert_allclose(
+                    yt[0, 0, i, j], (win ** 2).sum() / win.sum(), rtol=1e-5)
+
+    @pytest.mark.parametrize("method", ["MAX", "AVE"])
+    def test_gradcheck(self, method):
+        layer, _ = make_layer("Pooling", [(1, 2, 4, 4)],
+                              pooling_param=dict(pool=method, kernel_size=3,
+                                                 stride=2, pad=1))
+        # distinct values keep max-pool away from ties
+        x = np.arange(32, dtype=np.float32).reshape(1, 2, 4, 4) / 7.0
+        x += 0.01 * RNG.randn(*x.shape).astype(np.float32)
+        check_grad(lambda v: (layer.apply([], [v], False, None)[0]
+                              * jnp.arange(18.0).reshape(1, 2, 3, 3)).sum(),
+                   x, step=1e-3)
+
+
+class TestLRN:
+    def test_across_channels_formula(self):
+        layer, _ = make_layer("LRN", [(1, 5, 2, 2)],
+                              lrn_param=dict(local_size=3, alpha=0.1,
+                                             beta=0.75))
+        x = RNG.rand(1, 5, 2, 2).astype(np.float32)
+        (y,) = layer.apply([], [jnp.asarray(x)], False, None)
+        # channel 2 at (0,0): window channels 1..3
+        s = 1.0 + (0.1 / 3) * (x[0, 1:4, 0, 0] ** 2).sum()
+        np.testing.assert_allclose(y[0, 2, 0, 0], x[0, 2, 0, 0] * s ** -0.75,
+                                   rtol=1e-5)
+        # edge channel 0: window channels 0..1 (zero padded below)
+        s0 = 1.0 + (0.1 / 3) * (x[0, 0:2, 0, 0] ** 2).sum()
+        np.testing.assert_allclose(y[0, 0, 0, 0], x[0, 0, 0, 0] * s0 ** -0.75,
+                                   rtol=1e-5)
+
+    def test_within_channel_formula(self):
+        # CIFAR-full config: local_size 3, WITHIN_CHANNEL
+        layer, _ = make_layer("LRN", [(1, 1, 3, 3)],
+                              lrn_param=dict(local_size=3, alpha=5e-5,
+                                             beta=0.75,
+                                             norm_region="WITHIN_CHANNEL"))
+        x = RNG.rand(1, 1, 3, 3).astype(np.float32)
+        (y,) = layer.apply([], [jnp.asarray(x)], False, None)
+        # center: full 3x3 window, AVE divisor 9
+        s = 1.0 + 5e-5 * ((x[0, 0] ** 2).sum() / 9.0)
+        np.testing.assert_allclose(y[0, 0, 1, 1], x[0, 0, 1, 1] * s ** -0.75,
+                                   rtol=1e-5)
+        # corner (0,0): window [-1,2)x[-1,2) -> 4 real values, divisor 9
+        sc = 1.0 + 5e-5 * ((x[0, 0, :2, :2] ** 2).sum() / 9.0)
+        np.testing.assert_allclose(y[0, 0, 0, 0], x[0, 0, 0, 0] * sc ** -0.75,
+                                   rtol=1e-5)
+
+    @pytest.mark.parametrize("region", ["ACROSS_CHANNELS", "WITHIN_CHANNEL"])
+    def test_gradcheck(self, region):
+        layer, _ = make_layer("LRN", [(1, 4, 3, 3)],
+                              lrn_param=dict(local_size=3, alpha=0.05,
+                                             beta=0.75, norm_region=region))
+        x = np.asarray(RNG.randn(1, 4, 3, 3), np.float32)
+        wts = jnp.asarray(RNG.rand(1, 4, 3, 3), jnp.float32)
+        check_grad(lambda v: (layer.apply([], [v], False, None)[0]
+                              * wts).sum(), x, step=1e-2)
+
+
+class TestInnerProduct:
+    def test_forward_and_axis(self):
+        layer, _ = make_layer("InnerProduct", [(2, 3, 4, 4)],
+                              inner_product_param=dict(num_output=7))
+        params = init_params(layer)
+        assert params[0].shape == (7, 48)
+        x = RNG.randn(2, 3, 4, 4).astype(np.float32)
+        (y,) = layer.apply(params, [jnp.asarray(x)], False, None)
+        want = x.reshape(2, 48) @ np.asarray(params[0]).T + np.asarray(params[1])
+        np.testing.assert_allclose(y, want, rtol=1e-4)
+
+    def test_gradcheck(self):
+        layer, _ = make_layer("InnerProduct", [(2, 5)],
+                              inner_product_param=dict(num_output=3))
+        params = init_params(layer)
+        x = np.asarray(RNG.randn(2, 5), np.float32)
+        check_grad(lambda v: layer.apply(params, [v], False, None)[0].sum(), x)
+        check_grad(lambda w: layer.apply([w, params[1]], [jnp.asarray(x)],
+                                         False, None)[0].sum(),
+                   np.asarray(params[0]))
+
+
+class TestActivations:
+    def test_relu_and_leaky(self):
+        layer, _ = make_layer("ReLU", [(2, 3)])
+        x = jnp.asarray([[-1.0, 0.0, 2.0], [3.0, -4.0, 5.0]])
+        (y,) = layer.apply([], [x], False, None)
+        np.testing.assert_allclose(y, [[0, 0, 2], [3, 0, 5]])
+        layer, _ = make_layer("ReLU", [(2, 3)],
+                              relu_param=dict(negative_slope=0.1))
+        (y,) = layer.apply([], [x], False, None)
+        np.testing.assert_allclose(y, [[-0.1, 0, 2], [3, -0.4, 5]], rtol=1e-6)
+
+    def test_prelu(self):
+        layer, _ = make_layer("PReLU", [(2, 3, 2, 2)])
+        params = [jnp.asarray([0.1, 0.2, 0.3])]
+        x = -jnp.ones((2, 3, 2, 2))
+        (y,) = layer.apply(params, [x], False, None)
+        np.testing.assert_allclose(y[0, :, 0, 0], [-0.1, -0.2, -0.3],
+                                   rtol=1e-6)
+
+    def test_dropout_train_test(self):
+        layer, _ = make_layer("Dropout", [(1000,)],
+                              dropout_param=dict(dropout_ratio=0.3))
+        x = jnp.ones((1000,))
+        (y,) = layer.apply([], [x], True, jax.random.PRNGKey(0))
+        kept = float((y > 0).mean())
+        assert abs(kept - 0.7) < 0.05
+        np.testing.assert_allclose(np.asarray(y)[np.asarray(y) > 0],
+                                   1.0 / 0.7, rtol=1e-5)
+        (y,) = layer.apply([], [x], False, None)
+        np.testing.assert_allclose(y, x)
+
+    def test_power_exp_log_bnll_threshold_absval(self):
+        x = jnp.asarray([[0.5, 1.0, 2.0]])
+        layer, _ = make_layer("Power", [(1, 3)],
+                              power_param=dict(power=2.0, scale=3.0,
+                                               shift=1.0))
+        (y,) = layer.apply([], [x], False, None)
+        np.testing.assert_allclose(y, (1 + 3 * np.asarray(x)) ** 2, rtol=1e-5)
+        layer, _ = make_layer("Exp", [(1, 3)],
+                              exp_param=dict(base=2.0))
+        (y,) = layer.apply([], [x], False, None)
+        np.testing.assert_allclose(y, 2.0 ** np.asarray(x), rtol=1e-5)
+        layer, _ = make_layer("Log", [(1, 3)])
+        (y,) = layer.apply([], [x], False, None)
+        np.testing.assert_allclose(y, np.log(np.asarray(x)), rtol=1e-5)
+        layer, _ = make_layer("BNLL", [(1, 3)])
+        (y,) = layer.apply([], [x], False, None)
+        np.testing.assert_allclose(y, np.log1p(np.exp(np.asarray(x))),
+                                   rtol=1e-5)
+        layer, _ = make_layer("Threshold", [(1, 3)],
+                              threshold_param=dict(threshold=0.75))
+        (y,) = layer.apply([], [x], False, None)
+        np.testing.assert_allclose(y, [[0.0, 1.0, 1.0]])
+        layer, _ = make_layer("AbsVal", [(1, 3)])
+        (y,) = layer.apply([], [-x], False, None)
+        np.testing.assert_allclose(y, x)
+
+    @pytest.mark.parametrize("ltype", ["Sigmoid", "TanH", "BNLL", "PReLU"])
+    def test_gradcheck(self, ltype):
+        layer, _ = make_layer(ltype, [(2, 3)])
+        params = init_params(layer)
+        x = np.asarray(RNG.randn(2, 3), np.float32) + 0.2
+        check_grad(lambda v: (layer.apply(params, [v], False, None)[0]
+                              * jnp.asarray([[1., 2, 3], [4, 5, 6]])).sum(), x)
+
+
+class TestBatchNorm:
+    def test_train_normalizes_and_updates_state(self):
+        layer, _ = make_layer("BatchNorm", [(4, 3, 2, 2)])
+        state = [jnp.zeros(3), jnp.zeros(3), jnp.zeros(1)]
+        x = jnp.asarray(RNG.randn(4, 3, 2, 2) * 2 + 1, jnp.float32)
+        (y,), st = layer.apply_stateful([], state, [x], True,
+                                        jax.random.PRNGKey(0))
+        np.testing.assert_allclose(np.asarray(y).mean(axis=(0, 2, 3)), 0,
+                                   atol=1e-5)
+        np.testing.assert_allclose(np.asarray(y).var(axis=(0, 2, 3)), 1,
+                                   atol=1e-3)
+        np.testing.assert_allclose(st[2], [1.0])
+        m = 16
+        np.testing.assert_allclose(
+            st[1], np.asarray(x).var(axis=(0, 2, 3)) * m / (m - 1), rtol=1e-4)
+
+    def test_global_stats(self):
+        layer, _ = make_layer("BatchNorm", [(4, 2, 1, 1)], phase=1)
+        assert layer.use_global
+        mean = jnp.asarray([1.0, 2.0])
+        var = jnp.asarray([4.0, 9.0])
+        state = [mean * 2, var * 2, jnp.asarray([2.0])]  # scale factor 2
+        x = jnp.zeros((4, 2, 1, 1))
+        (y,), st = layer.apply_stateful([], state, [x], False, None)
+        want = (0 - np.asarray(mean)) / np.sqrt(np.asarray(var) + 1e-5)
+        np.testing.assert_allclose(y[0, :, 0, 0], want, rtol=1e-4)
+
+
+class TestStructural:
+    def test_softmax(self):
+        layer, _ = make_layer("Softmax", [(2, 5)])
+        x = RNG.randn(2, 5).astype(np.float32)
+        (y,) = layer.apply([], [jnp.asarray(x)], False, None)
+        e = np.exp(x - x.max(1, keepdims=True))
+        np.testing.assert_allclose(y, e / e.sum(1, keepdims=True), rtol=1e-5)
+
+    def test_concat_slice_roundtrip(self):
+        a = jnp.asarray(RNG.randn(2, 3, 2, 2), jnp.float32)
+        b = jnp.asarray(RNG.randn(2, 5, 2, 2), jnp.float32)
+        layer, _ = make_layer("Concat", [(2, 3, 2, 2), (2, 5, 2, 2)])
+        (y,) = layer.apply([], [a, b], False, None)
+        assert y.shape == (2, 8, 2, 2)
+        lp = Message("LayerParameter", name="s", type="Slice",
+                     top=["t1", "t2"], slice_param=dict(slice_point=[3]))
+        sl = get_layer("Slice")(lp, [(2, 8, 2, 2)], 0)
+        t1, t2 = sl.apply([], [y], False, None)
+        np.testing.assert_allclose(t1, a)
+        np.testing.assert_allclose(t2, b)
+
+    def test_flatten_reshape(self):
+        layer, _ = make_layer("Flatten", [(2, 3, 4, 5)])
+        assert layer.out_shapes() == [(2, 60)]
+        layer, _ = make_layer(
+            "Reshape", [(2, 8)],
+            reshape_param=dict(shape=dict(dim=[0, 2, -1])))
+        assert layer.out_shapes() == [(2, 2, 4)]
+        layer, _ = make_layer(
+            "Reshape", [(2, 8)],
+            reshape_param=dict(shape=dict(dim=[2, 4]), axis=1))
+        assert layer.out_shapes() == [(2, 2, 4)]
+
+    def test_eltwise(self):
+        a = jnp.asarray([[1.0, 2]])
+        b = jnp.asarray([[3.0, 4]])
+        for op, want in [("PROD", [[3, 8]]), ("SUM", [[4, 6]]),
+                         ("MAX", [[3, 4]])]:
+            layer, _ = make_layer("Eltwise", [(1, 2), (1, 2)],
+                                  eltwise_param=dict(operation=op))
+            (y,) = layer.apply([], [a, b], False, None)
+            np.testing.assert_allclose(y, want)
+        layer, _ = make_layer("Eltwise", [(1, 2), (1, 2)],
+                              eltwise_param=dict(operation="SUM",
+                                                 coeff=[2.0, -1.0]))
+        (y,) = layer.apply([], [a, b], False, None)
+        np.testing.assert_allclose(y, [[-1, 0]])
+
+    def test_tile_argmax_reduction(self):
+        layer, _ = make_layer("Tile", [(2, 3)], tile_param=dict(tiles=2))
+        (y,) = layer.apply([], [jnp.asarray([[1., 2, 3], [4, 5, 6]])],
+                           False, None)
+        assert y.shape == (2, 6)
+        layer, _ = make_layer("ArgMax", [(2, 4)])
+        (y,) = layer.apply([], [jnp.asarray([[1., 9, 2, 3], [7, 1, 8, 2]])],
+                           False, None)
+        np.testing.assert_allclose(y[:, 0, 0], [1, 2])
+        layer, _ = make_layer("Reduction", [(2, 3)],
+                              reduction_param=dict(operation="MEAN", axis=1,
+                                                   coeff=2.0))
+        (y,) = layer.apply([], [jnp.asarray([[1., 2, 3], [4, 5, 6]])],
+                           False, None)
+        np.testing.assert_allclose(y, [4.0, 10.0])
+
+    def test_embed_batchreindex(self):
+        layer, _ = make_layer("Embed", [(4,)],
+                              embed_param=dict(num_output=3, input_dim=5))
+        params = init_params(layer)
+        idx = jnp.asarray([0, 2, 4, 2])
+        (y,) = layer.apply(params, [idx], False, None)
+        np.testing.assert_allclose(
+            y, np.asarray(params[0])[np.asarray(idx)] + np.asarray(params[1]),
+            rtol=1e-5)
+        layer, _ = make_layer("BatchReindex", [(3, 2), (4,)])
+        (y,) = layer.apply([], [jnp.asarray([[1., 1], [2, 2], [3, 3]]),
+                                jnp.asarray([2, 0, 1, 1])], False, None)
+        np.testing.assert_allclose(y[:, 0], [3, 1, 2, 2])
+
+    def test_mvn(self):
+        layer, _ = make_layer("MVN", [(2, 3, 4, 4)])
+        x = jnp.asarray(RNG.randn(2, 3, 4, 4) * 3 + 2, jnp.float32)
+        (y,) = layer.apply([], [x], False, None)
+        np.testing.assert_allclose(np.asarray(y).mean(axis=(2, 3)), 0,
+                                   atol=1e-5)
+        std = np.asarray(y).std(axis=(2, 3))
+        np.testing.assert_allclose(std, 1.0, atol=1e-2)
+
+
+class TestLosses:
+    def test_softmax_loss_uniform(self):
+        layer, _ = make_layer("SoftmaxWithLoss", [(4, 10), (4,)])
+        x = jnp.zeros((4, 10))
+        lab = jnp.asarray([1, 2, 3, 4])
+        (loss,) = layer.apply([], [x, lab], True, None)
+        np.testing.assert_allclose(loss, np.log(10), rtol=1e-5)
+
+    def test_softmax_loss_spatial_and_ignore(self):
+        lp = Message("LayerParameter", type="SoftmaxWithLoss",
+                     loss_param=dict(ignore_label=255))
+        layer = get_layer("SoftmaxWithLoss")(lp, [(2, 3, 2, 2), (2, 2, 2)], 0)
+        x = jnp.asarray(RNG.randn(2, 3, 2, 2), jnp.float32)
+        lab = np.zeros((2, 2, 2), np.int32)
+        lab[1, 1, 1] = 255
+        (loss,) = layer.apply([], [x, jnp.asarray(lab)], True, None)
+        # manual
+        xs = np.asarray(x)
+        e = np.exp(xs - xs.max(1, keepdims=True))
+        p = e / e.sum(1, keepdims=True)
+        total, cnt = 0.0, 0
+        for i in range(2):
+            for h in range(2):
+                for w in range(2):
+                    if lab[i, h, w] == 255:
+                        continue
+                    total -= np.log(p[i, lab[i, h, w], h, w])
+                    cnt += 1
+        np.testing.assert_allclose(loss, total / cnt, rtol=1e-5)
+
+    def test_softmax_loss_gradcheck(self):
+        layer, _ = make_layer("SoftmaxWithLoss", [(3, 5), (3,)])
+        lab = jnp.asarray([0, 2, 4])
+        x = np.asarray(RNG.randn(3, 5), np.float32)
+        check_grad(lambda v: layer.apply([], [v, lab], True, None)[0], x)
+
+    def test_euclidean(self):
+        layer, _ = make_layer("EuclideanLoss", [(4, 3), (4, 3)])
+        a = jnp.asarray(RNG.randn(4, 3), jnp.float32)
+        b = jnp.asarray(RNG.randn(4, 3), jnp.float32)
+        (loss,) = layer.apply([], [a, b], True, None)
+        np.testing.assert_allclose(
+            loss, ((np.asarray(a) - np.asarray(b)) ** 2).sum() / 8, rtol=1e-5)
+        x = np.asarray(a)
+        check_grad(lambda v: layer.apply([], [v, b], True, None)[0], x)
+
+    def test_hinge_l1(self):
+        layer, _ = make_layer("HingeLoss", [(2, 3), (2,)])
+        x = jnp.asarray([[2.0, -1.0, 0.5], [0.0, 3.0, -2.0]])
+        lab = jnp.asarray([0, 1])
+        (loss,) = layer.apply([], [x, lab], True, None)
+        # i=0: margins max(0, 1 + [-2, -1... wait sign: correct class
+        # negated: [1-2, 1-1+... manual:
+        m0 = [max(0, 1 - 2.0), max(0, 1 + -1.0), max(0, 1 + 0.5)]
+        m1 = [max(0, 1 + 0.0), max(0, 1 - 3.0), max(0, 1 + -2.0)]
+        np.testing.assert_allclose(loss, (sum(m0) + sum(m1)) / 2, rtol=1e-5)
+
+    def test_sigmoid_ce(self):
+        layer, _ = make_layer("SigmoidCrossEntropyLoss", [(3, 4), (3, 4)])
+        x = jnp.asarray(RNG.randn(3, 4), jnp.float32)
+        t = jnp.asarray(RNG.rand(3, 4) > 0.5, jnp.float32)
+        (loss,) = layer.apply([], [x, t], True, None)
+        p = 1 / (1 + np.exp(-np.asarray(x)))
+        want = -(np.asarray(t) * np.log(p) +
+                 (1 - np.asarray(t)) * np.log(1 - p)).sum() / 3
+        np.testing.assert_allclose(loss, want, rtol=1e-4)
+        check_grad(lambda v: layer.apply([], [v, t], True, None)[0],
+                   np.asarray(x))
+
+    def test_multinomial_and_infogain_identity(self):
+        probs = jnp.asarray(RNG.dirichlet(np.ones(4), size=3), jnp.float32)
+        lab = jnp.asarray([0, 1, 2])
+        layer, _ = make_layer("MultinomialLogisticLoss", [(3, 4), (3,)])
+        (loss,) = layer.apply([], [probs, lab], True, None)
+        want = -np.log(np.asarray(probs)[np.arange(3), [0, 1, 2]]).sum() / 3
+        np.testing.assert_allclose(loss, want, rtol=1e-5)
+        # Infogain with identity H == multinomial logistic
+        lp = Message("LayerParameter", type="InfogainLoss")
+        ig = get_layer("InfogainLoss")(lp, [(3, 4), (3,), (4, 4)], 0)
+        (loss2,) = ig.apply([], [probs, lab, jnp.eye(4)], True, None)
+        np.testing.assert_allclose(loss2, want, rtol=1e-5)
+
+    def test_contrastive(self):
+        a = jnp.asarray(RNG.randn(4, 3), jnp.float32)
+        b = jnp.asarray(RNG.randn(4, 3), jnp.float32)
+        y = jnp.asarray([1, 0, 1, 0], jnp.float32)
+        layer, _ = make_layer("ContrastiveLoss", [(4, 3), (4, 3), (4,)],
+                              contrastive_loss_param=dict(margin=2.0))
+        (loss,) = layer.apply([], [a, b, y], True, None)
+        d = np.asarray(a) - np.asarray(b)
+        dsq = (d ** 2).sum(1)
+        ya = np.asarray(y)
+        want = (ya * dsq + (1 - ya) *
+                np.maximum(2.0 - np.sqrt(dsq), 0) ** 2).sum() / 8
+        np.testing.assert_allclose(loss, want, rtol=1e-5)
+
+    def test_accuracy_topk(self):
+        x = jnp.asarray([[0.1, 0.9, 0.0, 0.0],
+                         [0.5, 0.1, 0.4, 0.0],
+                         [0.0, 0.2, 0.3, 0.5]])
+        lab = jnp.asarray([1, 2, 0])
+        layer, _ = make_layer("Accuracy", [(3, 4), (3,)])
+        (acc,) = layer.apply([], [x, lab], False, None)
+        np.testing.assert_allclose(acc, 1.0 / 3.0, rtol=1e-6)
+        layer, _ = make_layer("Accuracy", [(3, 4), (3,)],
+                              accuracy_param=dict(top_k=2))
+        (acc,) = layer.apply([], [x, lab], False, None)
+        np.testing.assert_allclose(acc, 2.0 / 3.0, rtol=1e-6)
