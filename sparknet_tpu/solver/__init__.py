@@ -1,0 +1,9 @@
+"""Solver suite: Caffe-parity optimizers + lr policies + orchestration
+(replaces the reference caffe::Solver hierarchy, solver.cpp + solvers/*)."""
+
+from .solver import Solver, resolve_nets
+from .updates import Updater, canonical_type, SOLVER_TYPES
+from .lr_policy import make_lr_fn
+
+__all__ = ["Solver", "resolve_nets", "Updater", "canonical_type",
+           "SOLVER_TYPES", "make_lr_fn"]

@@ -1,0 +1,48 @@
+"""Learning-rate policies (reference sgd_solver.cpp GetLearningRate :27-79).
+
+Each returns a jnp scalar from a (possibly traced) iteration index so the
+whole schedule lives inside the jitted train step — no per-iteration
+recompiles, no host round trip.
+
+  fixed:     base_lr
+  step:      base_lr * gamma ^ floor(iter / stepsize)
+  exp:       base_lr * gamma ^ iter
+  inv:       base_lr * (1 + gamma * iter) ^ -power
+  multistep: base_lr * gamma ^ (#stepvalues <= iter)
+  poly:      base_lr * (1 - iter/max_iter) ^ power
+  sigmoid:   base_lr * 1/(1 + exp(-gamma * (iter - stepsize)))
+"""
+
+import numpy as np
+import jax.numpy as jnp
+
+
+def make_lr_fn(sp):
+    """SolverParameter -> fn(iter) -> lr (jnp scalar)."""
+    policy = sp.lr_policy
+    base_lr = float(sp.base_lr)
+    if policy == "fixed":
+        return lambda it: jnp.asarray(base_lr, jnp.float32)
+    if policy == "step":
+        stepsize = int(sp.stepsize)
+        gamma = float(sp.gamma)
+        return lambda it: base_lr * gamma ** jnp.floor(it / stepsize)
+    if policy == "exp":
+        gamma = float(sp.gamma)
+        return lambda it: base_lr * gamma ** it.astype(jnp.float32) \
+            if hasattr(it, "astype") else base_lr * gamma ** it
+    if policy == "inv":
+        gamma, power = float(sp.gamma), float(sp.power)
+        return lambda it: base_lr * (1.0 + gamma * it) ** (-power)
+    if policy == "multistep":
+        steps = jnp.asarray(list(sp.stepvalue), jnp.int32)
+        gamma = float(sp.gamma)
+        return lambda it: base_lr * gamma ** jnp.sum(steps <= it)
+    if policy == "poly":
+        power = float(sp.power)
+        max_iter = int(sp.max_iter)
+        return lambda it: base_lr * (1.0 - it / max_iter) ** power
+    if policy == "sigmoid":
+        gamma, stepsize = float(sp.gamma), int(sp.stepsize)
+        return lambda it: base_lr / (1.0 + jnp.exp(-gamma * (it - stepsize)))
+    raise ValueError(f"unknown lr_policy {policy!r}")
