@@ -215,11 +215,11 @@ class TestSolverEndToEnd:
                      type=stype, random_seed=1, display=0)
         s = Solver(sp, net_param=_mlp_net(), log_fn=None)
         data = _toy_batches(16)
-        steps = 200 if stype == "AdaDelta" else 60  # adadelta ramps slowly
-        first = float(s.train_step(next(data)))
-        for _ in range(steps):
-            last = float(s.train_step(next(data)))
-        assert last < first * 0.7, f"{stype}: {first} -> {last}"
+        steps = 300 if stype == "AdaDelta" else 60  # adadelta ramps slowly
+        losses = [float(s.train_step(next(data))) for _ in range(steps)]
+        head = np.mean(losses[:10])
+        tail = np.mean(losses[-10:])
+        assert tail < head * 0.8, f"{stype}: {head} -> {tail}"
 
     def test_iter_size_equivalence(self):
         # iter_size=2 with half-batches == one step on the full batch
