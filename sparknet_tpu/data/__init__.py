@@ -1,0 +1,9 @@
+"""Host-side data loading (replaces the reference's RDD -> JNA callback
+path with loader-push into device memory)."""
+
+from .cifar import CifarDataset, read_batch_file, write_batch_file
+from .sampler import MinibatchSampler
+from .synthetic import class_gaussian_images, batch_stream
+
+__all__ = ["CifarDataset", "read_batch_file", "write_batch_file",
+           "MinibatchSampler", "class_gaussian_images", "batch_stream"]

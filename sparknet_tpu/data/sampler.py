@@ -1,0 +1,38 @@
+"""Minibatch sampling over a partition's batch stream.
+
+Behavioral port of reference MinibatchSampler.scala: from a stream of
+``totalNumBatches`` minibatches, sample a random *contiguous window* of
+``numSampledBatches`` (start index uniform in [0, total - sampled],
+MinibatchSampler.scala:20-21) and iterate it. The reference's dual
+image/label callback trick (:28-60) existed only because Caffe pulled
+images and labels through two separate C callbacks against one iterator;
+with dict batches there is nothing to keep in lock-step.
+"""
+
+import numpy as np
+
+
+class MinibatchSampler:
+    def __init__(self, batches, total_num_batches, num_sampled_batches,
+                 rng=None):
+        """batches: iterable of batch dicts (or (images, labels) tuples)."""
+        rng = rng or np.random
+        self.start = int(rng.randint(0, total_num_batches
+                                     - num_sampled_batches + 1))
+        self.num_sampled = num_sampled_batches
+        self._it = iter(batches)
+        self._pos = -1
+        self._emitted = 0
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._emitted >= self.num_sampled:
+            raise StopIteration
+        target = self.start + self._emitted
+        while self._pos < target:
+            batch = next(self._it)
+            self._pos += 1
+        self._emitted += 1
+        return batch

@@ -1,0 +1,6 @@
+"""Model DSL + zoo (replaces reference Layers.scala + caffe/models/*)."""
+
+from . import dsl
+from .zoo import lenet, cifar10_full, caffenet, googlenet
+
+__all__ = ["dsl", "lenet", "cifar10_full", "caffenet", "googlenet"]

@@ -1,0 +1,133 @@
+"""Model-building DSL: Python builders producing LayerParameter messages.
+
+The capability of the reference's Scala DSL (Layers.scala:18-137 — RDDLayer,
+ConvolutionLayer, PoolingLayer, InnerProductLayer, ReLULayer, SoftmaxWithLoss,
+NetParam), extended with the builders the bigger nets need (LRN, Dropout,
+Concat, Accuracy, BatchNorm, Eltwise, Attention). Each returns a proto
+Message, so DSL output and parsed prototxt are the same IR.
+"""
+
+from ..proto import Message
+
+TRAIN, TEST = "TRAIN", "TEST"
+
+
+def _base(type_name, name, bottoms=None, tops=None, include=None, **fields):
+    lp = Message("LayerParameter", name=name, type=type_name, **fields)
+    for b in (bottoms or []):
+        lp.bottom.append(b)
+    tops = [name] if tops is None else tops
+    for t in tops:
+        lp.top.append(t)
+    if include is not None:
+        lp.add("include", phase=include)
+    return lp
+
+
+def RDDLayer(name, shape, include=None):
+    """Data feed layer (reference Layers.scala RDDLayer :18-40): one top,
+    named after the layer, shape fixed up front."""
+    return _base("JavaData", name, include=include,
+                 java_data_param=dict(shape=dict(dim=list(shape))))
+
+
+def ConvolutionLayer(name, bottoms, kernel, num_output, stride=None, pad=None,
+                     group=None, weight_filler=None, bias_filler=None,
+                     param=None):
+    cp = dict(kernel_h=kernel[0], kernel_w=kernel[1], num_output=num_output)
+    if stride is not None:
+        cp.update(stride_h=stride[0], stride_w=stride[1])
+    if pad is not None:
+        cp.update(pad_h=pad[0], pad_w=pad[1])
+    if group is not None:
+        cp["group"] = group
+    if weight_filler is not None:
+        cp["weight_filler"] = weight_filler
+    if bias_filler is not None:
+        cp["bias_filler"] = bias_filler
+    lp = _base("Convolution", name, bottoms, convolution_param=cp)
+    for p in (param or []):
+        lp.add("param", **p)
+    return lp
+
+
+def PoolingLayer(name, bottoms, pooling, kernel, stride):
+    """pooling: 'MAX' | 'AVE' | 'STOCHASTIC' (Layers.scala PoolingLayer)."""
+    return _base("Pooling", name, bottoms, pooling_param=dict(
+        pool=pooling, kernel_h=kernel[0], kernel_w=kernel[1],
+        stride_h=stride[0], stride_w=stride[1]))
+
+
+def InnerProductLayer(name, bottoms, num_output, weight_filler=None,
+                      bias_filler=None, param=None):
+    ip = dict(num_output=num_output)
+    if weight_filler is not None:
+        ip["weight_filler"] = weight_filler
+    if bias_filler is not None:
+        ip["bias_filler"] = bias_filler
+    lp = _base("InnerProduct", name, bottoms, inner_product_param=ip)
+    for p in (param or []):
+        lp.add("param", **p)
+    return lp
+
+
+def ReLULayer(name, bottoms, tops=None):
+    return _base("ReLU", name, bottoms, tops=tops)
+
+
+def SoftmaxWithLoss(name, bottoms):
+    return _base("SoftmaxWithLoss", name, bottoms)
+
+
+def AccuracyLayer(name, bottoms, top_k=1, include=TEST):
+    return _base("Accuracy", name, bottoms, include=include,
+                 accuracy_param=dict(top_k=top_k))
+
+
+def LRNLayer(name, bottoms, local_size=5, alpha=1.0, beta=0.75,
+             norm_region="ACROSS_CHANNELS"):
+    return _base("LRN", name, bottoms, lrn_param=dict(
+        local_size=local_size, alpha=alpha, beta=beta,
+        norm_region=norm_region))
+
+
+def DropoutLayer(name, bottoms, tops=None, ratio=0.5):
+    return _base("Dropout", name, bottoms, tops=tops,
+                 dropout_param=dict(dropout_ratio=ratio))
+
+
+def ConcatLayer(name, bottoms, axis=1):
+    return _base("Concat", name, bottoms, concat_param=dict(axis=axis))
+
+
+def BatchNormLayer(name, bottoms, tops=None, **kw):
+    return _base("BatchNorm", name, bottoms, tops=tops,
+                 batch_norm_param=kw or None)
+
+
+def EltwiseLayer(name, bottoms, operation="SUM", coeff=None):
+    ep = dict(operation=operation)
+    if coeff:
+        ep["coeff"] = list(coeff)
+    return _base("Eltwise", name, bottoms, eltwise_param=ep)
+
+
+def SoftmaxLayer(name, bottoms):
+    return _base("Softmax", name, bottoms)
+
+
+def AttentionLayer(name, bottoms, num_heads, head_dim=None, causal=False,
+                   ring=False):
+    """sparknet_tpu extension for the long-context path (see
+    parallel.ring_attention)."""
+    ap = dict(num_heads=num_heads, causal=causal, ring=ring)
+    if head_dim is not None:
+        ap["head_dim"] = head_dim
+    return _base("Attention", name, bottoms, attention_param=ap)
+
+
+def NetParam(name, *layers):
+    net = Message("NetParameter", name=name)
+    for l in layers:
+        net.layer.append(l)
+    return net

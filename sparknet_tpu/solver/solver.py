@@ -71,8 +71,7 @@ class Solver:
         self.net = CompiledNet(train_np, TRAIN, feed_shapes=feed_shapes,
                                dtype=dtype)
         self.test_net = None
-        if test_np is not None and (solver_param.test_iter or
-                                    solver_param.test_interval):
+        if test_np is not None:
             self.test_net = CompiledNet(
                 test_np, TEST,
                 feed_shapes=test_feed_shapes or feed_shapes, dtype=dtype)

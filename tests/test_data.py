@@ -1,0 +1,133 @@
+"""Data subsystem tests + the CIFAR end-to-end smoke/training tests.
+
+Mirrors reference CifarSpec.scala (random net scores near chance on CIFAR,
+:92 asserts 70-130% of 10x chance) and MinibatchSamplerSpec.scala (window
+sampling semantics), using synthetic CIFAR-format files — then goes further
+than the reference: trains the full CIFAR10_full net to above-chance
+accuracy in-process.
+"""
+
+import numpy as np
+import pytest
+import jax
+
+from sparknet_tpu.data import (CifarDataset, read_batch_file,
+                               write_batch_file, MinibatchSampler,
+                               class_gaussian_images, batch_stream)
+from sparknet_tpu.models import cifar10_full
+from sparknet_tpu.proto import Message
+from sparknet_tpu.solver import Solver
+
+
+@pytest.fixture(scope="module")
+def cifar_dir(tmp_path_factory):
+    """Synthetic CIFAR-10-format directory: 5 train batches + test batch,
+    with class-dependent image content so nets can learn."""
+    d = tmp_path_factory.mktemp("cifar")
+    rs = np.random.RandomState(0)
+    protos = rs.randint(0, 255, size=(10, 3, 32, 32)).astype(np.float32)
+    def gen(n, seed):
+        r = np.random.RandomState(seed)
+        labels = r.randint(0, 10, n)
+        noise = r.randint(-40, 40, size=(n, 3, 32, 32))
+        images = np.clip(0.7 * protos[labels] + noise, 0, 255).astype(np.uint8)
+        return images, labels
+    for i in range(1, 6):
+        imgs, labs = gen(400, i)
+        write_batch_file(str(d / f"data_batch_{i}.bin"), imgs, labs)
+    imgs, labs = gen(400, 99)
+    write_batch_file(str(d / "test_batch.bin"), imgs, labs)
+    return str(d)
+
+
+class TestCifarLoader:
+    def test_batch_file_roundtrip(self, tmp_path):
+        imgs = np.random.RandomState(0).randint(
+            0, 256, size=(10, 3, 32, 32)).astype(np.uint8)
+        labs = np.arange(10) % 10
+        p = str(tmp_path / "b.bin")
+        write_batch_file(p, imgs, labs)
+        ri, rl = read_batch_file(p)
+        np.testing.assert_array_equal(ri, imgs)
+        np.testing.assert_array_equal(rl, labs)
+
+    def test_dataset_load(self, cifar_dir):
+        ds = CifarDataset(cifar_dir, seed=0)
+        assert ds.train_images.shape == (2000, 3, 32, 32)
+        assert ds.test_images.shape == (400, 3, 32, 32)
+        assert ds.mean_image.shape == (3, 32, 32)
+        np.testing.assert_allclose(
+            ds.mean_image, ds.train_images.astype(np.float64).mean(0),
+            atol=1e-3)
+
+    def test_minibatches_drop_ragged(self, cifar_dir):
+        ds = CifarDataset(cifar_dir, seed=0)
+        batches = list(ds.minibatches(300, train=False))
+        assert len(batches) == 1  # 400 // 300
+        assert batches[0]["data"].shape == (300, 3, 32, 32)
+        # mean-subtracted data is roughly centered
+        assert abs(batches[0]["data"].mean()) < 20
+
+
+class TestMinibatchSampler:
+    def test_contiguous_window(self):
+        batches = [{"i": i} for i in range(10)]
+        rng = np.random.RandomState(3)
+        s = MinibatchSampler(batches, 10, 4, rng=rng)
+        got = [b["i"] for b in s]
+        assert len(got) == 4
+        assert got == list(range(got[0], got[0] + 4))
+        assert 0 <= got[0] <= 6
+
+    def test_full_window(self):
+        batches = [{"i": i} for i in range(5)]
+        s = MinibatchSampler(batches, 5, 5, rng=np.random.RandomState(0))
+        assert [b["i"] for b in s] == [0, 1, 2, 3, 4]
+
+
+def make_cifar_solver(log_fn=None, **overrides):
+    # cifar10_full_solver.prototxt schedule, shrunk for test runtime
+    kw = dict(base_lr=0.001, lr_policy="fixed", momentum=0.9,
+              weight_decay=0.004, random_seed=2, display=0)
+    kw.update(overrides)
+    sp = Message("SolverParameter", **kw)
+    return Solver(sp, net_param=cifar10_full(batch_size=50), log_fn=log_fn)
+
+
+class TestCifarEndToEnd:
+    def test_chance_accuracy_random_net(self, cifar_dir):
+        """Reference CifarSpec.scala:92: an untrained net must score within
+        70-130% of chance x 10 on CIFAR."""
+        ds = CifarDataset(cifar_dir, seed=0)
+        s = make_cifar_solver()
+        scores = s.test(iter(list(ds.minibatches(50, train=False))),
+                        num_iters=8)
+        acc = float(scores["accuracy"])
+        assert 0.07 <= acc <= 0.13, acc
+
+    def test_training_beats_chance(self, cifar_dir):
+        """The round-1 'aha': DSL-built CIFAR net + real solver schedule
+        learns synthetic CIFAR far past chance inside the test suite —
+        a closed training loop the reference could only run on a cluster."""
+        ds = CifarDataset(cifar_dir, seed=0)
+        s = make_cifar_solver()
+        stream = batch_stream(
+            (ds.train_images.astype(np.float32) - ds.mean_image),
+            ds.train_labels, 50, seed=1)
+        for _ in range(120):
+            s.train_step(next(stream))
+        test_batches = list(ds.minibatches(50, train=False))
+        acc = float(s.test(iter(test_batches), num_iters=8)["accuracy"])
+        assert acc > 0.3, f"expected >0.3 accuracy (chance 0.1), got {acc}"
+
+
+class TestSyntheticData:
+    def test_class_gaussians_learnable_shapes(self):
+        x, y = class_gaussian_images(100, seed=0)
+        assert x.shape == (100, 3, 32, 32) and y.shape == (100,)
+
+    def test_batch_stream_epochs(self):
+        x, y = class_gaussian_images(10, seed=0)
+        st = batch_stream(x, y, 4, loop=False)
+        batches = list(st)
+        assert len(batches) == 2  # ragged tail dropped
