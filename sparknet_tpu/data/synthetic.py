@@ -24,6 +24,9 @@ def batch_stream(images, labels, batch_size, loop=True, seed=0,
     """Shuffled minibatch dict stream; reshuffles each epoch."""
     rs = np.random.RandomState(seed)
     n = len(images) // batch_size * batch_size
+    if n == 0:
+        raise ValueError(f"batch_size {batch_size} > dataset size "
+                         f"{len(images)}: stream would be empty")
     while True:
         perm = rs.permutation(len(images))[:n]
         for i in range(0, n, batch_size):

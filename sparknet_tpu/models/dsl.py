@@ -51,11 +51,13 @@ def ConvolutionLayer(name, bottoms, kernel, num_output, stride=None, pad=None,
     return lp
 
 
-def PoolingLayer(name, bottoms, pooling, kernel, stride):
+def PoolingLayer(name, bottoms, pooling, kernel, stride, pad=None):
     """pooling: 'MAX' | 'AVE' | 'STOCHASTIC' (Layers.scala PoolingLayer)."""
-    return _base("Pooling", name, bottoms, pooling_param=dict(
-        pool=pooling, kernel_h=kernel[0], kernel_w=kernel[1],
-        stride_h=stride[0], stride_w=stride[1]))
+    pp = dict(pool=pooling, kernel_h=kernel[0], kernel_w=kernel[1],
+              stride_h=stride[0], stride_w=stride[1])
+    if pad is not None:
+        pp["pad"] = pad
+    return _base("Pooling", name, bottoms, pooling_param=pp)
 
 
 def InnerProductLayer(name, bottoms, num_output, weight_filler=None,

@@ -5,8 +5,6 @@ caffe/models/bvlc_googlenet), re-expressed with the DSL so the framework is
 self-contained — no prototxt files needed (though stock ones load too).
 """
 
-from ..proto import Message
-from . import dsl
 from .dsl import (NetParam, RDDLayer, ConvolutionLayer, PoolingLayer,
                   InnerProductLayer, ReLULayer, SoftmaxWithLoss,
                   AccuracyLayer, LRNLayer, DropoutLayer, ConcatLayer)
@@ -170,15 +168,13 @@ def _inception(name, bottom, widths):
                   tops=[f"{p}/5x5_reduce"]),
         _gconv(f"{p}/5x5", f"{p}/5x5_reduce", n5, 5, pad=2),
         ReLULayer(f"{p}/relu_5x5", [f"{p}/5x5"], tops=[f"{p}/5x5"]),
-        PoolingLayer(f"{p}/pool", [bottom], "MAX", (3, 3), (1, 1)),
+        PoolingLayer(f"{p}/pool", [bottom], "MAX", (3, 3), (1, 1), pad=1),
         _gconv(f"{p}/pool_proj", f"{p}/pool", pp, 1),
         ReLULayer(f"{p}/relu_pool_proj", [f"{p}/pool_proj"],
                   tops=[f"{p}/pool_proj"]),
         ConcatLayer(f"{p}/output",
                     [f"{p}/1x1", f"{p}/3x3", f"{p}/5x5", f"{p}/pool_proj"]),
     ]
-    # the pool layer above needs pad 1 to keep spatial dims
-    layers[10].pooling_param.pad = 1
     return layers, f"{p}/output"
 
 

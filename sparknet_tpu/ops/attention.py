@@ -1,0 +1,67 @@
+"""Multi-head self-attention — the long-context extension layer.
+
+Not in the CNN-era reference (SURVEY.md section 5: no attention anywhere);
+this is the sparknet_tpu-native layer that the sequence-parallel machinery
+(parallel.ring) plugs into. Bottom blob: (B, S, E). Fused QKV projection
+keeps one large MXU matmul; when the net is traced inside a sequence-sharded
+shard_map (parallel.context provides a "seq" axis) and attention_param.ring
+is set, the core switches to ring attention over the mesh — the layer code
+is identical on 1 chip and on a 64-way ring.
+"""
+
+import jax.numpy as jnp
+
+from ..proto import Message
+from ..graph.registry import Layer, register
+from ..parallel import context
+from ..parallel.ring import ring_attention, dense_attention
+from .convolution import _param_mults
+
+
+@register
+class Attention(Layer):
+    type_name = "Attention"
+
+    def __init__(self, lp, bottom_shapes, phase):
+        super().__init__(lp, bottom_shapes, phase)
+        p = lp.attention_param
+        self.p = p
+        b, s, e = bottom_shapes[0]
+        self.embed = int(e)
+        self.num_heads = int(p.num_heads)
+        self.head_dim = int(p.head_dim) if p.has("head_dim") \
+            else self.embed // self.num_heads
+        self.causal = bool(p.causal)
+        self.ring = bool(p.ring)
+        self.inner = self.num_heads * self.head_dim
+
+    def param_shapes(self):
+        mults = _param_mults(self.lp, 4)
+        # unlike stock Caffe layers (default constant-0), an attention with
+        # zero projections is a degenerate identity-killer — default xavier
+        wf = self.p.weight_filler if self.p.has("weight_filler") \
+            else Message("FillerParameter", type="xavier")
+        return [
+            ((3 * self.inner, self.embed), wf, *mults[0]),   # fused qkv
+            ((3 * self.inner,), None, *mults[1]),
+            ((self.embed, self.inner), wf, *mults[2]),       # out proj
+            ((self.embed,), None, *mults[3]),
+        ]
+
+    def out_shapes(self):
+        return [tuple(self.bottom_shapes[0])]
+
+    def apply(self, params, bottoms, train, rng):
+        x = bottoms[0]                                   # (B, S, E)
+        wqkv, bqkv, wo, bo = [p.astype(x.dtype) for p in params]
+        b, s, _ = x.shape
+        qkv = x @ wqkv.T + bqkv                          # (B, S, 3*H*D)
+        qkv = qkv.reshape(b, s, 3, self.num_heads, self.head_dim)
+        q, k, v = [jnp.moveaxis(qkv[:, :, i], 1, 2) for i in range(3)]
+        seq_axis = context.axis("seq")
+        if self.ring and seq_axis is not None:
+            o = ring_attention(q, k, v, seq_axis, causal=self.causal)
+        else:
+            o = dense_attention(q, k, v, causal=self.causal)
+        o = jnp.moveaxis(o, 2, 1).reshape(b, s, self.inner)
+        return [o @ wo.T + bo]

@@ -59,9 +59,18 @@ class JavaData(FeedLayer):
 
     def out_shapes(self):
         p = self.lp.java_data_param
-        if p.has("shape"):
-            return [tuple(int(d) for d in p.shape.dim)]
-        return self._external_shapes()
+        shapes = []
+        for i, top in enumerate(self.lp.top):
+            if top in self.feed_shapes:  # build-time override (e.g. the
+                shapes.append(tuple(self.feed_shapes[top]))  # per-shard net)
+            elif i == 0 and p.has("shape"):
+                # java_data_param.shape describes the FIRST top only
+                shapes.append(tuple(int(d) for d in p.shape.dim))
+            else:
+                raise ValueError(
+                    f"JavaData layer {self.lp.name!r}: no shape for top "
+                    f"{top!r} (provide feed_shapes[{top!r}])")
+        return shapes
 
 
 @register

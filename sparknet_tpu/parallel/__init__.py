@@ -1,0 +1,48 @@
+"""parallel — the distributed heart of sparknet_tpu.
+
+Replaces BOTH of the reference's communication mechanisms with XLA
+collectives over a named device mesh:
+
+  * the Spark driver loop (broadcast weights -> tau local SGD steps per
+    worker -> collect & average; CifarApp.scala:92-135, Net.scala:14-47)
+    becomes `LocalSGDSolver`: one jitted "round" under shard_map whose only
+    communication is a single pmean over the ICI mesh per round;
+  * Caffe's intra-node GPU tree allreduce (parallel.cpp P2PSync:271-437)
+    becomes `DataParallelSolver`: per-step gradient psum inside the compiled
+    train step.
+
+Long-context sequence parallelism (absent in the CNN-era reference but
+first-class here) lives in `ring`: ring attention via ppermute and
+Ulysses-style all-to-all head/sequence resharding.
+"""
+
+import importlib
+
+__all__ = [
+    "make_mesh", "mesh_axis_size", "distributed_init", "local_batch_slice",
+    "axis_context", "current_axes", "context",
+    "DataParallelSolver", "LocalSGDSolver", "shard_batch",
+    "ring_attention", "ulysses_attention", "sequence_sharded_apply",
+]
+
+# lazy exports (PEP 562): ops.attention imports parallel.{context,ring} while
+# parallel.data_parallel imports solver -> graph -> ops; deferring the
+# data_parallel import breaks the cycle.
+_EXPORTS = {
+    "make_mesh": "mesh", "mesh_axis_size": "mesh",
+    "distributed_init": "mesh", "local_batch_slice": "mesh",
+    "axis_context": "context", "current_axes": "context",
+    "DataParallelSolver": "data_parallel", "LocalSGDSolver": "data_parallel",
+    "shard_batch": "data_parallel",
+    "ring_attention": "ring", "ulysses_attention": "ring",
+    "sequence_sharded_apply": "ring",
+}
+
+
+def __getattr__(name):
+    if name in _EXPORTS:
+        mod = importlib.import_module(f".{_EXPORTS[name]}", __name__)
+        return getattr(mod, name)
+    if name in ("mesh", "context", "ring", "data_parallel"):
+        return importlib.import_module(f".{name}", __name__)
+    raise AttributeError(name)

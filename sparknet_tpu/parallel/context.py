@@ -1,0 +1,35 @@
+"""Trace-time parallelism context.
+
+Layers that can exploit a mesh axis (Attention's ring mode, sharded
+InnerProduct) need to know, while being traced, which named axes the
+surrounding shard_map provides. jax deliberately hides this, so the
+distributed runners publish it here before tracing the net body. The axis
+names get baked into the traced computation — exactly once, at compile time.
+"""
+
+import contextlib
+import threading
+
+_state = threading.local()
+
+
+def current_axes():
+    """Mapping {logical_axis: mesh_axis_name or None} in effect."""
+    return getattr(_state, "axes", {})
+
+
+@contextlib.contextmanager
+def axis_context(**axes):
+    """e.g. with axis_context(data="data", seq="seq"): trace the step."""
+    prev = current_axes()
+    merged = dict(prev)
+    merged.update(axes)
+    _state.axes = merged
+    try:
+        yield merged
+    finally:
+        _state.axes = prev
+
+
+def axis(name):
+    return current_axes().get(name)

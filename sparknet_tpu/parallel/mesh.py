@@ -1,0 +1,86 @@
+"""Device mesh construction + multi-host bring-up.
+
+The reference's cluster substrate was spark-ec2 + JVM broadcast (SURVEY L7);
+here the substrate is a `jax.sharding.Mesh` whose axes name the parallelism
+strategies. Axis names used throughout the framework:
+
+  "data"   data parallelism (gradient psum / local-SGD pmean)
+  "model"  tensor parallelism (reserved; used by sharded InnerProduct)
+  "seq"    sequence/context parallelism (ring attention)
+  "pipe"   pipeline parallelism (reserved)
+"""
+
+import os
+
+import numpy as np
+import jax
+from jax.sharding import Mesh
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+SEQ_AXIS = "seq"
+PIPE_AXIS = "pipe"
+
+
+def make_mesh(axes=None, devices=None):
+    """Build a Mesh from {axis_name: size}. A single -1 size is inferred
+    from the device count (like a reshape). Default: all devices on "data".
+
+    >>> make_mesh({"data": -1})
+    >>> make_mesh({"data": 2, "seq": 4})
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    axes = dict(axes or {DATA_AXIS: n})
+    sizes = list(axes.values())
+    if sizes.count(-1) > 1:
+        raise ValueError("at most one axis size may be -1")
+    if -1 in sizes:
+        known = int(np.prod([s for s in sizes if s != -1], dtype=np.int64))
+        if n % known:
+            raise ValueError(f"{n} devices not divisible by {known}")
+        sizes[sizes.index(-1)] = n // known
+    total = int(np.prod(sizes, dtype=np.int64))
+    if total > n:
+        raise ValueError(f"mesh {axes} needs {total} devices, have {n}")
+    arr = np.array(devices[:total]).reshape(sizes)
+    return Mesh(arr, tuple(axes.keys()))
+
+
+def mesh_axis_size(mesh, axis):
+    return mesh.shape[axis] if axis in mesh.shape else 1
+
+
+def distributed_init(coordinator_address=None, num_processes=None,
+                     process_id=None):
+    """Multi-host bring-up over DCN — the analog of the reference's
+    spark-submit cluster launch (SETUP.md). On TPU pods the three args are
+    discovered from the metadata server; env vars override for manual runs.
+
+    No-op when running single-process (the common dev path)."""
+    coordinator_address = coordinator_address or os.environ.get(
+        "SPARKNET_COORDINATOR")
+    if num_processes is None:
+        num_processes = int(os.environ.get("SPARKNET_NUM_PROCESSES", 0)) or None
+    if process_id is None:
+        pid = os.environ.get("SPARKNET_PROCESS_ID")
+        process_id = int(pid) if pid is not None else None
+    if coordinator_address is None and num_processes is None:
+        return False  # single-process
+    jax.distributed.initialize(coordinator_address=coordinator_address,
+                               num_processes=num_processes,
+                               process_id=process_id)
+    return True
+
+
+def local_batch_slice(global_batch_size, mesh=None, axis=DATA_AXIS):
+    """(start, size) of this host's slice of the global batch — the analog of
+    Spark's per-worker RDD partition (CifarApp.scala repartition :64): each
+    host loads only its own shard of every global batch."""
+    pcount = jax.process_count()
+    pid = jax.process_index()
+    if global_batch_size % pcount:
+        raise ValueError(f"global batch {global_batch_size} not divisible by "
+                         f"{pcount} hosts")
+    per = global_batch_size // pcount
+    return pid * per, per

@@ -144,10 +144,25 @@ class Solver:
         return jax.jit(ev)
 
     # -- public API --------------------------------------------------------
+    def check_batch(self, batch, leading=()):
+        """Fail fast with blob names when a feed array has the wrong shape
+        (otherwise the error is a cryptic reshape deep inside some layer)."""
+        for name, want in self.net.feed_shapes().items():
+            if name not in batch:
+                raise ValueError(f"batch missing feed blob {name!r} "
+                                 f"(needs {sorted(self.net.feed_shapes())})")
+            got = tuple(np.shape(batch[name]))
+            if got != tuple(leading) + tuple(want):
+                raise ValueError(
+                    f"feed blob {name!r}: got shape {got}, net was compiled "
+                    f"for {tuple(leading) + tuple(want)}")
+
     def train_step(self, batch):
         """One optimization step; returns the (unsmoothed) loss value."""
         if self._jit_train is None:
             self._jit_train = self._build_train_step()
+        iter_size = int(self.param.iter_size)
+        self.check_batch(batch, leading=(iter_size,) if iter_size > 1 else ())
         self.rng, key = jax.random.split(self.rng)
         batch = {k: jnp.asarray(v) for k, v in batch.items()}
         t0 = time.perf_counter()
