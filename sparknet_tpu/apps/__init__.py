@@ -1,0 +1,7 @@
+"""Training drivers — the L1 "apps" layer of the reference (CifarApp.scala,
+ImageNetApp.scala), re-expressed over the mesh instead of a Spark cluster."""
+
+from .cifar_app import CifarApp
+from .imagenet_app import ImageNetApp
+
+__all__ = ["CifarApp", "ImageNetApp"]

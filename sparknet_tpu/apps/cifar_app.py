@@ -1,0 +1,159 @@
+"""CIFAR-10 training driver — the reference CifarApp.scala, mesh-native.
+
+The reference driver (CifarApp.scala:33-135): load CIFAR from binary
+batches, build cifar10_full with JavaData layers, then loop
+{broadcast weights -> each of N workers runs tau=10 local SGD steps on its
+partition -> collect & average}, testing every 10 rounds. Here the loop body
+is LocalSGDSolver.train_round — one XLA program per round whose only
+collective is a pmean — or, with strategy="dp", per-step gradient pmean
+(which the reference could not express at all between machines).
+
+Timing log: elapsed-seconds-prefixed phases, like the reference's
+training_log_<ts>.txt (CifarApp.scala:43-52).
+"""
+
+import os
+import time
+
+import numpy as np
+
+from ..proto import Message
+from ..models import zoo
+from ..models.proto_loader import (load_net_prototxt,
+                                   load_solver_prototxt_with_net,
+                                   replace_data_layers)
+from ..data.cifar import CifarDataset
+from ..data.synthetic import class_gaussian_images
+from ..parallel import make_mesh, DataParallelSolver, LocalSGDSolver
+
+TRAIN_BATCH = 100   # cifar10_full_train_test.prototxt batch sizes
+TEST_BATCH = 100
+NUM_TEST = 10000
+
+
+class CifarApp:
+    """num_workers = size of the "data" mesh axis (the reference's Spark
+    executor count, CifarApp.scala:34)."""
+
+    def __init__(self, num_workers=None, data_dir=None, prototxt_dir=None,
+                 strategy="local_sgd", tau=10, log_path=None, seed=None):
+        self.t0 = time.time()
+        self.logf = open(log_path, "w") if log_path else None
+        mesh = make_mesh({"data": num_workers if num_workers else -1})
+        self.num_workers = mesh.shape["data"]
+        self.strategy = strategy
+
+        # data: real CIFAR binaries if present, synthetic stand-in otherwise
+        if data_dir and os.path.isdir(data_dir):
+            self.log(f"loading CIFAR-10 from {data_dir}")
+            self.data = CifarDataset(data_dir, seed=seed)
+        else:
+            self.log("no CIFAR data dir; using synthetic class-gaussians")
+            self.data = _SyntheticCifar(seed=seed or 0)
+
+        # net: stock prototxt (with data layers swapped like
+        # ProtoLoader.replaceDataLayers) or the built-in zoo twin
+        scale = 1 if strategy == "local_sgd" else self.num_workers
+        per_worker = TRAIN_BATCH * scale
+        if prototxt_dir:
+            net = load_net_prototxt(os.path.join(
+                prototxt_dir, "cifar10_full_train_test.prototxt"))
+            net = replace_data_layers(net, per_worker, TEST_BATCH * scale,
+                                      3, 32, 32)
+            solver_param = load_solver_prototxt_with_net(
+                os.path.join(prototxt_dir, "cifar10_full_solver.prototxt"),
+                net)
+        else:
+            net = zoo.cifar10_full(batch_size=per_worker)
+            solver_param = Message(
+                "SolverParameter", base_lr=0.001, momentum=0.9,
+                weight_decay=0.004, lr_policy="fixed", display=0,
+                random_seed=seed if seed is not None else -1)
+
+        if strategy == "local_sgd":
+            self.solver = LocalSGDSolver(solver_param, mesh=mesh, tau=tau,
+                                         net_param=net, log_fn=self.log)
+        else:
+            self.solver = DataParallelSolver(solver_param, mesh=mesh,
+                                             net_param=net, log_fn=self.log)
+        self.log(f"initialized: {self.num_workers} workers, "
+                 f"strategy={strategy}")
+
+    def log(self, msg):
+        line = f"{time.time() - self.t0:9.2f}: {msg}"
+        print(line)
+        if self.logf:
+            self.logf.write(line + "\n")
+            self.logf.flush()
+
+    # -- data feeds ---------------------------------------------------------
+    def _train_arrays(self, n_images):
+        imgs = self.data.train_images.astype(np.float32) - self.data.mean_image
+        labs = self.data.train_labels
+        idx = np.random.randint(0, len(imgs) - n_images + 1)
+        return imgs[idx:idx + n_images], labs[idx:idx + n_images]
+
+    def _tau_batches(self, tau):
+        """(tau, workers*batch, ...) arrays: each worker's contiguous window
+        of its partition (the MinibatchSampler random-window behavior)."""
+        n = tau * TRAIN_BATCH * self.num_workers
+        imgs, labs = self._train_arrays(n)
+        # worker w gets a contiguous run of tau batches from its partition;
+        # reorder to (tau, workers*batch) so shard_batch slices per worker
+        imgs = imgs.reshape(self.num_workers, tau, TRAIN_BATCH, 3, 32, 32) \
+            .transpose(1, 0, 2, 3, 4, 5) \
+            .reshape(tau, self.num_workers * TRAIN_BATCH, 3, 32, 32)
+        labs = labs.reshape(self.num_workers, tau, TRAIN_BATCH) \
+            .transpose(1, 0, 2).reshape(tau, -1)
+        return {"data": imgs, "label": labs}
+
+    def _test_batch_size(self):
+        # the TEST net's feed batch (global across the mesh for dp)
+        return self.solver.test_net.feed_shapes()["data"][0] \
+            if self.strategy == "local_sgd" \
+            else self.solver.net.feed_shapes()["data"][0]
+
+    def _test_iter(self):
+        imgs = self.data.test_images.astype(np.float32) - self.data.mean_image
+        labs = self.data.test_labels
+        bs = self._test_batch_size()
+        for i in range(0, len(imgs) // bs * bs, bs):
+            yield {"data": imgs[i:i + bs], "label": labs[i:i + bs]}
+
+    # -- the driver loop (CifarApp.scala:92-135) ---------------------------
+    def run(self, num_rounds=100, test_every=10):
+        for r in range(num_rounds):
+            if r % test_every == 0:
+                self.log("testing")
+                n = min(len(self.data.test_images) // self._test_batch_size(),
+                        100)
+                scores = self.solver.test(self._test_iter(), num_iters=n)
+                for k, v in scores.items():
+                    self.log(f"round {r}: test {k} = "
+                             f"{np.asarray(v).mean():.4f}")
+            self.log("broadcasting weights & running workers")
+            if self.strategy == "local_sgd":
+                loss = self.solver.train_round(
+                    self._tau_batches(self.solver.tau))
+            else:
+                imgs, labs = self._train_arrays(
+                    TRAIN_BATCH * self.num_workers)
+                loss = self.solver.train_step({"data": imgs, "label": labs})
+            self.log(f"round {r}: loss = {float(loss):.4f}")
+        return self.solver
+
+
+class _SyntheticCifar:
+    """CifarDataset-shaped stand-in when no binary data is available."""
+
+    def __init__(self, n_train=2000, n_test=500, seed=0):
+        ti, tl = class_gaussian_images(n_train, shape=(3, 32, 32),
+                                       num_classes=10, seed=seed)
+        vi, vl = class_gaussian_images(n_test, shape=(3, 32, 32),
+                                       num_classes=10, seed=seed + 1)
+        self.train_images = np.asarray(ti)
+        self.train_labels = np.asarray(tl)
+        self.test_images = np.asarray(vi)
+        self.test_labels = np.asarray(vl)
+        self.mean_image = self.train_images.astype(np.float64).mean(0) \
+            .astype(np.float32)

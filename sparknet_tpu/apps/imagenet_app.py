@@ -1,0 +1,145 @@
+"""ImageNet/CaffeNet training driver — the reference ImageNetApp.scala.
+
+Reference behavior: AlexNet-class CaffeNet, batch 256, 256x256 source
+images, random 227x227 crop + mean subtraction on TRAIN (center crop on
+TEST), mean image via ComputeMean, tau=50 local steps per round.
+Data arrives as (image, label) record streams (reference: S3 tar archives
+-> RDD; here: any iterator of (N,3,256,256) uint8 batches — see
+sparknet_tpu.data.imagenet for the tar reader).
+"""
+
+import os
+import time
+
+import numpy as np
+
+from ..proto import Message
+from ..models import zoo
+from ..data.transforms import (random_crop, center_crop, subtract_mean,
+                               compute_mean)
+from ..data.synthetic import class_gaussian_images
+from ..parallel import make_mesh, DataParallelSolver, LocalSGDSolver
+
+SOURCE_SIZE = 256
+CROP = 227
+BATCH = 256
+
+
+class ImageNetApp:
+    def __init__(self, num_workers=None, train_source=None, test_source=None,
+                 num_classes=1000, strategy="local_sgd", tau=50, batch=BATCH,
+                 log_path=None, seed=0):
+        self.t0 = time.time()
+        self.logf = open(log_path, "w") if log_path else None
+        mesh = make_mesh({"data": num_workers if num_workers else -1})
+        self.num_workers = mesh.shape["data"]
+        self.strategy = strategy
+        self.batch = batch
+        self.num_classes = num_classes
+        self.rng = np.random.RandomState(seed)
+
+        if train_source is None:
+            self.log("no ImageNet source; using synthetic class-gaussians")
+            train_source = _synthetic_source(self.rng, num_classes)
+            test_source = _synthetic_source(
+                np.random.RandomState(seed + 1), num_classes)
+        self.train_source = train_source
+        self.test_source = test_source
+
+        self.log("computing mean image (ComputeMean.scala equivalent)")
+        probe = [next(self.train_source) for _ in range(4)]
+        self.mean_image = compute_mean(
+            (b[0] for b in probe), (3, SOURCE_SIZE, SOURCE_SIZE))
+
+        scale = 1 if strategy == "local_sgd" else self.num_workers
+        net = zoo.caffenet(batch_size=batch * scale, num_classes=num_classes,
+                           crop_size=CROP)
+        solver_param = Message(
+            "SolverParameter", base_lr=0.01, momentum=0.9,
+            weight_decay=0.0005, lr_policy="step", gamma=0.1, stepsize=100000,
+            display=0, random_seed=seed)
+        if strategy == "local_sgd":
+            self.solver = LocalSGDSolver(solver_param, mesh=mesh, tau=tau,
+                                         net_param=net, log_fn=self.log)
+        else:
+            self.solver = DataParallelSolver(solver_param, mesh=mesh,
+                                             net_param=net, log_fn=self.log)
+        self.log(f"initialized: {self.num_workers} workers, "
+                 f"strategy={strategy}, batch={batch * scale}")
+
+    def log(self, msg):
+        line = f"{time.time() - self.t0:9.2f}: {msg}"
+        print(line)
+        if self.logf:
+            self.logf.write(line + "\n")
+            self.logf.flush()
+
+    # -- preprocessing (ImageNetApp.scala:155-169 / :117-131) --------------
+    def _prep_train(self, images):
+        return subtract_mean(
+            random_crop(images, CROP, rng=self.rng, mirror=True),
+            self.mean_image)
+
+    def _prep_test(self, images):
+        return subtract_mean(center_crop(images, CROP), self.mean_image)
+
+    def _collect(self, source, n, prep):
+        imgs, labs = [], []
+        have = 0
+        while have < n:
+            bi, bl = next(source)
+            imgs.append(bi)
+            labs.append(bl)
+            have += len(bi)
+        images = np.concatenate(imgs)[:n]
+        labels = np.concatenate(labs)[:n]
+        return prep(images), labels
+
+    # -- driver loop (ImageNetApp.scala:100-182) ---------------------------
+    def run(self, num_rounds=10, test_every=10, test_iters=4):
+        for r in range(num_rounds):
+            if test_every and r % test_every == 0 and self.test_source:
+                def it():
+                    bs = self.batch * (1 if self.strategy == "local_sgd"
+                                       else self.num_workers)
+                    while True:
+                        d, l = self._collect(self.test_source, bs,
+                                             self._prep_test)
+                        yield {"data": d, "label": l}
+                scores = self.solver.test(it(), num_iters=test_iters)
+                for k, v in scores.items():
+                    self.log(f"round {r}: test {k} = "
+                             f"{np.asarray(v).mean():.4f}")
+            if self.strategy == "local_sgd":
+                tau = self.solver.tau
+                d, l = self._collect(
+                    self.train_source, tau * self.batch * self.num_workers,
+                    self._prep_train)
+                batches = {
+                    "data": d.reshape(self.num_workers, tau, self.batch,
+                                      3, CROP, CROP)
+                    .transpose(1, 0, 2, 3, 4, 5)
+                    .reshape(tau, -1, 3, CROP, CROP),
+                    "label": l.reshape(self.num_workers, tau, self.batch)
+                    .transpose(1, 0, 2).reshape(tau, -1)}
+                loss = self.solver.train_round(batches)
+            else:
+                d, l = self._collect(self.train_source,
+                                     self.batch * self.num_workers,
+                                     self._prep_train)
+                loss = self.solver.train_step({"data": d, "label": l})
+            self.log(f"round {r}: loss = {float(loss):.4f}")
+        return self.solver
+
+
+def _synthetic_source(rng, num_classes, batch=64):
+    """Endless (images uint8 (N,3,256,256), labels) batch generator."""
+    def gen():
+        while True:
+            images, labels = class_gaussian_images(
+                batch, shape=(3, SOURCE_SIZE, SOURCE_SIZE),
+                num_classes=num_classes, seed=int(rng.randint(1 << 31)))
+            img8 = np.clip(np.asarray(images) * 32 + 128, 0, 255) \
+                .astype(np.uint8)
+            yield img8, np.asarray(labels)
+    return gen()

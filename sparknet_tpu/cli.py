@@ -1,0 +1,303 @@
+"""Command-line interface — the reference native CLI (tools/caffe.cpp).
+
+Verbs (mirroring the brew registry, caffe.cpp:55):
+  train         train from a -solver prototxt (caffe.cpp:153)
+  test          score a model (caffe.cpp:222)
+  time          per-layer fwd/bwd timing (caffe.cpp:290)
+  device_query  enumerate devices (caffe.cpp:110)
+plus the app drivers:
+  cifar         CifarApp (reference src/main/scala/apps/CifarApp.scala)
+  imagenet      ImageNetApp (reference ImageNetApp.scala)
+
+Signal semantics follow the reference flags -sigint_effect/-sighup_effect
+(caffe.cpp:43-46): snapshot / stop / none.
+"""
+
+import argparse
+import json
+import sys
+import time
+
+
+def _mesh_arg(s):
+    """"data=8,seq=2" -> {"data": 8, "seq": 2}; "8" -> {"data": 8}."""
+    if s.isdigit():
+        return {"data": int(s)}
+    out = {}
+    for part in s.split(","):
+        k, _, v = part.partition("=")
+        out[k.strip()] = int(v)
+    return out
+
+
+def cmd_device_query(args):
+    import jax
+    for d in jax.devices():
+        print(f"id {d.id}: {d.device_kind} ({d.platform}) "
+              f"process {d.process_index}")
+    return 0
+
+
+def _make_data_iter(net, seed=0):
+    """Synthetic batch stream matching the net's feed shapes (stands in for
+    LMDB: the stock prototxt data sources are host-side concerns)."""
+    import numpy as np
+    rs = np.random.RandomState(seed)
+    shapes = net.feed_shapes()
+
+    def gen():
+        while True:
+            batch = {}
+            for name, shape in shapes.items():
+                if len(shape) <= 1 or "label" in name:
+                    batch[name] = rs.randint(0, 10, shape).astype(np.int32)
+                else:
+                    batch[name] = rs.randn(*shape).astype(np.float32)
+            yield batch
+    return gen()
+
+
+def _net_base_dir(sp, solver_path):
+    """Stock solver prototxts name their net relative to the caffe repo root
+    (e.g. "examples/cifar10/..."); caffe resolves against CWD. Walk up from
+    the solver file until the referenced net path exists."""
+    import os
+    rel = None
+    for f in ("net", "train_net"):
+        if sp.has(f):
+            rel = getattr(sp, f)
+            break
+    if rel is None or os.path.isabs(rel) or os.path.exists(rel):
+        return ""
+    d = os.path.dirname(os.path.abspath(solver_path))
+    while True:
+        if os.path.exists(os.path.join(d, rel)):
+            return d
+        parent = os.path.dirname(d)
+        if parent == d:
+            return ""
+        d = parent
+
+
+def _feed_shapes_arg(specs):
+    """["data=100,3,32,32", ...] -> {"data": (100,3,32,32)} (the shape LMDB
+    records would supply in stock caffe)."""
+    out = {}
+    for s in specs or ():
+        name, _, dims = s.partition("=")
+        out[name.strip()] = tuple(int(d) for d in dims.replace("x", ",")
+                                  .split(","))
+    return out
+
+
+def cmd_train(args):
+    from .proto import text_format
+    from .solver.solver import Solver
+    from .utils.signals import SignalPolicy
+
+    sp = text_format.load(args.solver, "SolverParameter")
+    base_dir = _net_base_dir(sp, args.solver)
+    feed = _feed_shapes_arg(args.input_shape)
+    if args.strategy == "dp":
+        from .parallel import DataParallelSolver, make_mesh
+        solver = DataParallelSolver(sp, mesh=make_mesh(_mesh_arg(args.mesh))
+                                    if args.mesh else None, base_dir=base_dir,
+                                    feed_shapes=feed)
+    else:
+        solver = Solver(sp, base_dir=base_dir, feed_shapes=feed)
+    if args.weights:
+        solver.load_weights(args.weights)
+    if args.snapshot:
+        solver.restore(args.snapshot)
+    total = args.iterations or int(sp.max_iter) or 1000
+    data_iter = _make_data_iter(solver.net)
+    test_fn = (lambda: _make_data_iter(solver.test_net, seed=1)) \
+        if solver.test_net is not None else None
+    prefix = args.snapshot_prefix or (
+        sp.snapshot_prefix if sp.has("snapshot_prefix") else None)
+    policy = SignalPolicy(sigint=args.sigint_effect,
+                          sighup=args.sighup_effect)
+    with policy:
+        while solver.iter < total:
+            n = min(100, total - solver.iter)
+            solver.step(n, data_iter, test_data_fn=test_fn)
+            action = policy.pending()
+            if action == "snapshot":
+                solver.snapshot(prefix=prefix or "snap")
+            elif action == "stop":
+                print("stopping early on signal")
+                break
+    if prefix and sp.snapshot:
+        solver.snapshot(prefix=prefix)
+    print(f"Optimization done, iter={solver.iter}")
+    return 0
+
+
+def cmd_test(args):
+    import numpy as np
+    from .proto import text_format
+    from .graph.compiler import CompiledNet, TEST
+    from .solver.solver import Solver
+    from .proto import Message
+
+    net_param = text_format.load(args.model, "NetParameter")
+    sp = Message("SolverParameter", base_lr=0.0, lr_policy="fixed",
+                 display=0)
+    sp.net_param = net_param
+    solver = Solver(sp, feed_shapes=_feed_shapes_arg(args.input_shape))
+    if args.weights:
+        solver.load_weights(args.weights)
+    it = _make_data_iter(solver.test_net or solver.net)
+    scores = solver.test(it, num_iters=args.iterations)
+    for k, v in scores.items():
+        print(f"{k} = {np.asarray(v).mean():.6f}")
+    return 0
+
+
+def cmd_time(args):
+    """Per-layer forward/backward timing — `caffe time` (caffe.cpp:290-376).
+    Each layer is jitted in isolation on random inputs of its true shapes."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from .proto import text_format
+    from .graph.compiler import CompiledNet, TRAIN
+
+    net_param = text_format.load(args.model, "NetParameter")
+    net = CompiledNet(net_param, TRAIN,
+                      feed_shapes=_feed_shapes_arg(args.input_shape))
+    params, state = net.init(jax.random.PRNGKey(0))
+    rs = np.random.RandomState(0)
+    iters = args.iterations
+    print(f"{'layer':<28}{'type':<18}{'fwd ms':>10}{'fwd+bwd ms':>12}")
+    total_f = total_fb = 0.0
+    for lp, impl, bottoms, tops in net.layers:
+        if getattr(impl, "is_feed", False):
+            continue
+        bvals = [jnp.asarray(rs.randn(*net.blob_shapes[b]), jnp.float32)
+                 for b in bottoms]
+        lparams = net.resolve_params(params, lp.name)
+        lstate = state.get(lp.name)
+        rng = jax.random.PRNGKey(0)
+
+        def fwd(lparams, bvals):
+            if impl.has_state:
+                tv, _ = impl.apply_stateful(lparams, lstate, bvals, True, rng)
+            else:
+                tv = impl.apply(lparams, bvals, True, rng)
+            return sum(jnp.sum(t.astype(jnp.float32)) for t in tv)
+
+        jf = jax.jit(fwd)
+        jg = jax.jit(jax.grad(lambda bv: fwd(lparams, bv), argnums=0))
+        try:
+            float(jf(lparams, bvals))         # compile + sanity
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                r = jf(lparams, bvals)
+            float(r)
+            f_ms = (time.perf_counter() - t0) / iters * 1e3
+            g = jg(bvals)
+            jax.tree_util.tree_map(lambda x: x.block_until_ready(), g)
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                g = jg(bvals)
+            float(jax.tree_util.tree_leaves(g)[0].ravel()[0])
+            fb_ms = f_ms + (time.perf_counter() - t0) / iters * 1e3
+        except Exception as e:                      # non-differentiable etc.
+            print(f"{lp.name:<28}{lp.type:<18}{'—':>10}  ({e})")
+            continue
+        total_f += f_ms
+        total_fb += fb_ms
+        print(f"{lp.name:<28}{lp.type:<18}{f_ms:>10.3f}{fb_ms:>12.3f}")
+    print(f"{'TOTAL':<28}{'':<18}{total_f:>10.3f}{total_fb:>12.3f}")
+    print("note: per-layer jit; the fused full-step is faster "
+          "(XLA cross-layer fusion)")
+    return 0
+
+
+def cmd_cifar(args):
+    from .apps import CifarApp
+    app = CifarApp(num_workers=args.workers, data_dir=args.data,
+                   prototxt_dir=args.prototxt_dir, strategy=args.strategy,
+                   tau=args.tau, log_path=args.log)
+    app.run(num_rounds=args.rounds)
+    return 0
+
+
+def cmd_imagenet(args):
+    from .apps import ImageNetApp
+    app = ImageNetApp(num_workers=args.workers, strategy=args.strategy,
+                      tau=args.tau, batch=args.batch, log_path=args.log,
+                      num_classes=args.classes)
+    app.run(num_rounds=args.rounds)
+    return 0
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        prog="sparknet",
+        description="TPU-native SparkNet: train/test/time/apps")
+    sub = p.add_subparsers(dest="verb", required=True)
+
+    t = sub.add_parser("train", help="train from a solver prototxt")
+    t.add_argument("--solver", required=True)
+    t.add_argument("--weights", help=".caffemodel to finetune from")
+    t.add_argument("--snapshot", help=".solverstate to resume from")
+    t.add_argument("--iterations", type=int, default=None)
+    t.add_argument("--strategy", choices=("single", "dp"), default="single")
+    t.add_argument("--mesh", help='e.g. "data=8"')
+    t.add_argument("--snapshot-prefix",
+                   help="override the solver's snapshot_prefix")
+    t.add_argument("--input-shape", action="append", default=[],
+                   help='feed blob shape hint, e.g. "data=100,3,32,32" '
+                        "(stands in for the LMDB record shape)")
+    t.add_argument("--sigint_effect", default="stop",
+                   choices=("snapshot", "stop", "none"))
+    t.add_argument("--sighup_effect", default="snapshot",
+                   choices=("snapshot", "stop", "none"))
+    t.set_defaults(fn=cmd_train)
+
+    te = sub.add_parser("test", help="score a model")
+    te.add_argument("--model", required=True)
+    te.add_argument("--weights")
+    te.add_argument("--iterations", type=int, default=50)
+    te.add_argument("--input-shape", action="append", default=[])
+    te.set_defaults(fn=cmd_test)
+
+    ti = sub.add_parser("time", help="per-layer timing")
+    ti.add_argument("--model", required=True)
+    ti.add_argument("--iterations", type=int, default=10)
+    ti.add_argument("--input-shape", action="append", default=[])
+    ti.set_defaults(fn=cmd_time)
+
+    d = sub.add_parser("device_query", help="list devices")
+    d.set_defaults(fn=cmd_device_query)
+
+    c = sub.add_parser("cifar", help="CifarApp driver")
+    c.add_argument("--workers", type=int, default=None)
+    c.add_argument("--data", help="dir with CIFAR-10 .bin batches")
+    c.add_argument("--prototxt-dir", help="dir with stock cifar10 prototxts")
+    c.add_argument("--strategy", choices=("local_sgd", "dp"),
+                   default="local_sgd")
+    c.add_argument("--tau", type=int, default=10)
+    c.add_argument("--rounds", type=int, default=20)
+    c.add_argument("--log")
+    c.set_defaults(fn=cmd_cifar)
+
+    i = sub.add_parser("imagenet", help="ImageNetApp driver")
+    i.add_argument("--workers", type=int, default=None)
+    i.add_argument("--strategy", choices=("local_sgd", "dp"),
+                   default="local_sgd")
+    i.add_argument("--tau", type=int, default=50)
+    i.add_argument("--batch", type=int, default=256)
+    i.add_argument("--classes", type=int, default=1000)
+    i.add_argument("--rounds", type=int, default=2)
+    i.add_argument("--log")
+    i.set_defaults(fn=cmd_imagenet)
+
+    args = p.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,67 @@
+"""Input transforms — the reference DataTransformer + app closures.
+
+Replaces caffe/src/caffe/data_transformer.cpp (crop/mirror/scale/mean,
+:42-51) and the per-image Scala preprocessing closures
+(ImageNetApp.scala:155-169: random 227x227 crop + mean subtraction; test
+variant :117-131: center crop). Vectorized over the whole batch — the
+reference looped per image per pixel in a JVM closure.
+"""
+
+import numpy as np
+
+
+def random_crop(images, crop, rng=None, mirror=False):
+    """(N, C, H, W) -> (N, C, crop, crop) with per-image random offsets
+    (+ optional per-image horizontal mirror, data_transformer.cpp:42-51)."""
+    rng = rng or np.random
+    n, c, h, w = images.shape
+    if h == crop and w == crop:
+        out = images
+    else:
+        ys = rng.randint(0, h - crop + 1, size=n)
+        xs = rng.randint(0, w - crop + 1, size=n)
+        out = np.empty((n, c, crop, crop), images.dtype)
+        for i in range(n):   # per-image offsets; the copy dominates anyway
+            out[i] = images[i, :, ys[i]:ys[i] + crop, xs[i]:xs[i] + crop]
+    if mirror:
+        flips = rng.randint(0, 2, size=n).astype(bool)
+        out = out.copy() if out is images else out
+        out[flips] = out[flips, :, :, ::-1]
+    return out
+
+
+def center_crop(images, crop):
+    """Deterministic center crop (TEST phase, ImageNetApp.scala:117-131)."""
+    n, c, h, w = images.shape
+    y, x = (h - crop) // 2, (w - crop) // 2
+    return images[:, :, y:y + crop, x:x + crop]
+
+
+def subtract_mean(images, mean_image):
+    """float32 output; mean may be a full CHW image (mean_file) or
+    per-channel values (mean_value)."""
+    images = np.asarray(images, np.float32)
+    mean = np.asarray(mean_image, np.float32)
+    if mean.ndim == 1:   # per-channel
+        mean = mean.reshape(-1, 1, 1)
+    if mean.ndim == 3 and mean.shape[-2:] != images.shape[-2:]:
+        # mean image larger than crop: use its center window (caffe requires
+        # equal dims after crop; data_transformer.cpp does the same check)
+        mh, mw = mean.shape[-2:]
+        h, w = images.shape[-2:]
+        y, x = (mh - h) // 2, (mw - w) // 2
+        mean = mean[:, y:y + h, x:x + w]
+    return images - mean
+
+
+def compute_mean(image_iter, shape):
+    """Streaming mean image over an iterator of (N, C, H, W) uint8 arrays —
+    the ComputeMean.scala:10-37 accumulator without the RDD."""
+    acc = np.zeros(shape, np.int64)
+    count = 0
+    for batch in image_iter:
+        acc += batch.astype(np.int64).sum(axis=0)
+        count += len(batch)
+    if count == 0:
+        raise ValueError("empty image stream")
+    return (acc / count).astype(np.float32)

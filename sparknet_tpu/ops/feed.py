@@ -66,6 +66,9 @@ class JavaData(FeedLayer):
             elif i == 0 and p.has("shape"):
                 # java_data_param.shape describes the FIRST top only
                 shapes.append(tuple(int(d) for d in p.shape.dim))
+            elif i > 0 and p.has("shape"):
+                # trailing tops are labels: (batch,), like Caffe data layers
+                shapes.append((int(p.shape.dim[0]),))
             else:
                 raise ValueError(
                     f"JavaData layer {self.lp.name!r}: no shape for top "

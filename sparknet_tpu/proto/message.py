@@ -47,13 +47,14 @@ class RepeatedField(list):
 
 
 class Message:
-    __slots__ = ("_type", "_fields")
+    __slots__ = ("_type", "_fields", "_frozen")
 
     def __init__(self, type_name, **kwargs):
         if type_name not in schema.MESSAGES:
             raise ValueError(f"unknown message type {type_name!r}")
         object.__setattr__(self, "_type", type_name)
         object.__setattr__(self, "_fields", {})
+        object.__setattr__(self, "_frozen", False)
         for k, v in kwargs.items():
             setattr(self, k, v)
 
@@ -93,18 +94,29 @@ class Message:
         if name in self._fields:
             return self._fields[name]
         if label != "opt":
+            if self._frozen:
+                return ()          # iterable, but appends impossible
             lst = RepeatedField(self, ftype)
             self._fields[name] = lst  # cached so appends stick
             return lst
         if schema.is_message(ftype):
             # protobuf semantics: reading an unset sub-message yields the
-            # default instance (uncached, so has() remains False)
-            return Message(ftype)
+            # default instance (uncached, so has() remains False). It is
+            # FROZEN: mutating it would otherwise vanish silently — build a
+            # Message(...) and assign it to the parent field instead.
+            m = Message(ftype)
+            object.__setattr__(m, "_frozen", True)
+            return m
         if default is not None:
             return default
         return schema.zero_value(ftype)
 
     def __setattr__(self, name, value):
+        if self._frozen:
+            raise AttributeError(
+                f"cannot set {name!r} on the default (unset) "
+                f"{self._type}: assign parent.field = Message({self._type!r},"
+                f" ...) first")
         num, ftype, label, default = self.spec(name)
         if label != "opt":
             value = RepeatedField(self, ftype, value)

@@ -223,6 +223,9 @@ class Solver:
     # -- checkpointing (reference solver.cpp Snapshot :447-521) ------------
     def snapshot(self, prefix=None):
         prefix = prefix or self.param.snapshot_prefix
+        d = os.path.dirname(prefix)
+        if d:
+            os.makedirs(d, exist_ok=True)
         model_path = f"{prefix}_iter_{self.iter}.caffemodel"
         state_path = f"{prefix}_iter_{self.iter}.solverstate"
         net_proto = self.net.params_to_netproto(self.params, self.state)

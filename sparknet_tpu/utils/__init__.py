@@ -1,0 +1,7 @@
+"""Runtime utilities: signal-driven snapshot/stop, metrics, timing."""
+
+from .signals import SignalPolicy
+from .metrics import MetricsLogger
+from .timing import Timer, StepTimer
+
+__all__ = ["SignalPolicy", "MetricsLogger", "Timer", "StepTimer"]

@@ -1,0 +1,30 @@
+"""Structured metrics — what the reference lacked (SURVEY.md section 5:
+"No structured metrics system"); loss/accuracy went to glog + ad-hoc
+timing logs (CifarApp.scala:43-52). One JSONL stream, one line per event."""
+
+import json
+import sys
+import time
+
+
+class MetricsLogger:
+    def __init__(self, path=None, stream=None, run_id=None):
+        self.f = open(path, "a") if path else (stream or sys.stderr)
+        self._own = path is not None
+        self.run_id = run_id
+        self.t0 = time.time()
+
+    def log(self, event, **fields):
+        rec = {"event": event, "t": round(time.time() - self.t0, 3)}
+        if self.run_id:
+            rec["run"] = self.run_id
+        for k, v in fields.items():
+            if hasattr(v, "item"):      # numpy/jax scalar
+                v = v.item()
+            rec[k] = v
+        self.f.write(json.dumps(rec) + "\n")
+        self.f.flush()
+
+    def close(self):
+        if self._own:
+            self.f.close()

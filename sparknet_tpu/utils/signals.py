@@ -1,0 +1,50 @@
+"""Signal-triggered snapshot/stop.
+
+The reference installs SIGINT/SIGHUP handlers whose effects (snapshot /
+stop / none) come from CLI flags (util/signal_handler.cpp:99-112,
+tools/caffe.cpp:43-46); the solver polls CheckForSignals between steps.
+Same design: handlers only record; the training loop polls pending().
+"""
+
+import signal
+
+
+ACTIONS = ("snapshot", "stop", "none")
+
+
+class SignalPolicy:
+    def __init__(self, sigint="stop", sighup="snapshot"):
+        for a in (sigint, sighup):
+            if a not in ACTIONS:
+                raise ValueError(f"unknown signal action {a!r}")
+        self.effects = {signal.SIGINT: sigint, signal.SIGHUP: sighup}
+        self._pending = []
+        self._prev = {}
+
+    def _handler(self, signum, frame):
+        action = self.effects.get(signum, "none")
+        if action == "none":
+            return
+        if action == "stop" and "stop" in self._pending:
+            # second ^C: restore default and re-raise (escape hatch)
+            signal.signal(signal.SIGINT, signal.SIG_DFL)
+            raise KeyboardInterrupt
+        self._pending.append(action)
+
+    def __enter__(self):
+        for signum in self.effects:
+            try:
+                self._prev[signum] = signal.signal(signum, self._handler)
+            except ValueError:        # non-main thread: polling still works
+                pass
+        return self
+
+    def __exit__(self, *exc):
+        for signum, prev in self._prev.items():
+            signal.signal(signum, prev)
+        return False
+
+    def pending(self):
+        """Pop the oldest pending action ('snapshot'|'stop') or None —
+        the Solver::GetRequestedAction analog."""
+        return self._pending.pop(0) if self._pending else None
