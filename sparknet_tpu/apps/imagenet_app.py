@@ -15,8 +15,7 @@ import numpy as np
 
 from ..proto import Message
 from ..models import zoo
-from ..data.transforms import (random_crop, center_crop, subtract_mean,
-                               compute_mean)
+from ..data.transforms import transform_train, transform_test, compute_mean
 from ..data.synthetic import class_gaussian_images
 from ..parallel import make_mesh, DataParallelSolver, LocalSGDSolver
 
@@ -76,12 +75,11 @@ class ImageNetApp:
 
     # -- preprocessing (ImageNetApp.scala:155-169 / :117-131) --------------
     def _prep_train(self, images):
-        return subtract_mean(
-            random_crop(images, CROP, rng=self.rng, mirror=True),
-            self.mean_image)
+        return transform_train(images, CROP, mean=self.mean_image,
+                               mirror=True, rng=self.rng)
 
     def _prep_test(self, images):
-        return subtract_mean(center_crop(images, CROP), self.mean_image)
+        return transform_test(images, CROP, mean=self.mean_image)
 
     def _collect(self, source, n, prep):
         imgs, labs = [], []

@@ -23,10 +23,9 @@ def read_batch_file(path):
     raw = np.fromfile(path, np.uint8)
     if raw.size % RECORD:
         raise ValueError(f"{path}: size {raw.size} not a multiple of {RECORD}")
-    recs = raw.reshape(-1, RECORD)
-    labels = recs[:, 0].astype(np.int32)
-    images = recs[:, 1:].reshape(-1, CHANNELS, HEIGHT, WIDTH)
-    return images, labels
+    from .. import native
+    images, labels = native.decode_cifar_records(raw, RECORD)
+    return images.reshape(-1, CHANNELS, HEIGHT, WIDTH), labels
 
 
 def write_batch_file(path, images, labels):

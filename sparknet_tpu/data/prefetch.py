@@ -1,0 +1,95 @@
+"""Background prefetch — the reference's double-buffered loader threads.
+
+Caffe's BasePrefetchingDataLayer ran an InternalThread pumping batches
+through a prefetch_free_/prefetch_full_ BlockingQueue pair
+(base_data_layer.cpp:70-101, data_layers.hpp:91-93). Same structure: a
+bounded queue (depth = the number of in-flight buffers), worker thread(s)
+running the host-side produce fn (decode/transform — which release the GIL
+in the native pipeline), and optionally jax.device_put so host->HBM copies
+overlap the running step.
+"""
+
+import queue
+import threading
+
+
+_END = object()
+
+
+class PrefetchIterator:
+    """Wrap a batch iterator (or factory) with N background workers.
+
+    depth: max buffered batches (2 = classic double buffering).
+    transform: optional fn(batch)->batch run in the worker (e.g. the crop/
+               mean native transform, or jax.device_put for H2D overlap).
+    workers > 1 preserves NO ordering guarantees (like the reference's
+    single reader it defaults to 1, which does).
+    """
+
+    def __init__(self, source, depth=2, transform=None, workers=1):
+        self._q = queue.Queue(maxsize=depth)
+        self._transform = transform
+        self._stop = threading.Event()
+        self._error = None
+        self._source = iter(source)
+        self._src_lock = threading.Lock()
+        self._threads = [
+            threading.Thread(target=self._run, daemon=True,
+                             name=f"sparknet-prefetch-{i}")
+            for i in range(workers)]
+        self._live = len(self._threads)
+        self._live_lock = threading.Lock()
+        for t in self._threads:
+            t.start()
+
+    def _run(self):
+        try:
+            while not self._stop.is_set():
+                with self._src_lock:
+                    try:
+                        item = next(self._source)
+                    except StopIteration:
+                        break
+                if self._transform is not None:
+                    item = self._transform(item)
+                while not self._stop.is_set():
+                    try:
+                        self._q.put(item, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+        except BaseException as e:     # surfaced on the consumer side
+            self._error = e
+        finally:
+            with self._live_lock:
+                self._live -= 1
+                if self._live == 0:
+                    self._q.put(_END)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        while True:
+            item = self._q.get()
+            if item is _END:
+                if self._error is not None:
+                    raise self._error
+                raise StopIteration
+            return item
+
+    def close(self):
+        self._stop.set()
+        # drain so producers blocked on put() can exit
+        try:
+            while True:
+                self._q.get_nowait()
+        except queue.Empty:
+            pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

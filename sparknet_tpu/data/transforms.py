@@ -9,6 +9,44 @@ reference looped per image per pixel in a JVM closure.
 
 import numpy as np
 
+from .. import native
+
+
+def transform_train(images, crop, mean=None, mirror=True, rng=None,
+                    scale=1.0):
+    """Fused random-crop + mirror + mean-subtract + scale, one native pass
+    over the batch (the data_transformer.cpp TRAIN path). mean must be
+    per-channel (C,) or already cropped (C,crop,crop)."""
+    rng = rng or np.random
+    n, c, h, w = images.shape
+    ys = rng.randint(0, h - crop + 1, size=n).astype(np.int32)
+    xs = rng.randint(0, w - crop + 1, size=n).astype(np.int32)
+    flips = rng.randint(0, 2, size=n).astype(np.uint8) if mirror else None
+    return native.transform_batch(images, crop, ys=ys, xs=xs, mirror=flips,
+                                  mean=_crop_mean(mean, c, crop),
+                                  scale=scale)
+
+
+def transform_test(images, crop, mean=None, scale=1.0):
+    """Fused center-crop + mean-subtract (the TEST path)."""
+    n, c, h, w = images.shape
+    ys = np.full(n, (h - crop) // 2, np.int32)
+    xs = np.full(n, (w - crop) // 2, np.int32)
+    return native.transform_batch(images, crop, ys=ys, xs=xs,
+                                  mean=_crop_mean(mean, c, crop),
+                                  scale=scale)
+
+
+def _crop_mean(mean, c, crop):
+    if mean is None:
+        return None
+    mean = np.asarray(mean, np.float32)
+    if mean.ndim == 3 and mean.shape[-2:] != (crop, crop):
+        mh, mw = mean.shape[-2:]
+        y, x = (mh - crop) // 2, (mw - crop) // 2
+        mean = np.ascontiguousarray(mean[:, y:y + crop, x:x + crop])
+    return mean
+
 
 def random_crop(images, crop, rng=None, mirror=False):
     """(N, C, H, W) -> (N, C, crop, crop) with per-image random offsets
@@ -60,7 +98,7 @@ def compute_mean(image_iter, shape):
     acc = np.zeros(shape, np.int64)
     count = 0
     for batch in image_iter:
-        acc += batch.astype(np.int64).sum(axis=0)
+        native.accumulate_sum(np.asarray(batch), acc)
         count += len(batch)
     if count == 0:
         raise ValueError("empty image stream")

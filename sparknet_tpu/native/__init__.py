@@ -1,0 +1,159 @@
+"""Native pipeline library: build-on-first-use + ctypes bindings.
+
+The reference shipped its native engine as a cmake-built libccaffe.so loaded
+via JNA (CaffeLibrary.java:9); here the native surface is the host data
+pipeline only (XLA owns device kernels), compiled lazily with g++ and loaded
+via ctypes. Everything has a numpy fallback — ``available()`` says which
+path is active.
+"""
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "pipeline.cpp")
+_SO = os.path.join(_DIR, "libsparknet_native.so")
+_ABI = 1
+
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+def _build():
+    base = ["g++", "-O3", "-march=native", "-shared", "-fPIC", "-std=c++17"]
+    try:
+        subprocess.run(base + ["-fopenmp", _SRC, "-o", _SO], check=True,
+                       capture_output=True)
+    except subprocess.CalledProcessError:   # no libgomp: single-threaded
+        subprocess.run(base + [_SRC, "-o", _SO], check=True,
+                       capture_output=True)
+
+
+def _load():
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        try:
+            if not os.path.exists(_SO) or \
+                    os.path.getmtime(_SO) < os.path.getmtime(_SRC):
+                _build()
+            lib = ctypes.CDLL(_SO)
+            if lib.native_abi_version() != _ABI:
+                _build()
+                lib = ctypes.CDLL(_SO)
+            _bind(lib)
+            _lib = lib
+        except Exception:
+            _lib = None
+        return _lib
+
+
+def _bind(lib):
+    i64, i32p = ctypes.c_int64, ctypes.POINTER(ctypes.c_int32)
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    f32p = ctypes.POINTER(ctypes.c_float)
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    lib.transform_batch.argtypes = [
+        u8p, i64, i64, i64, i64, i64, i32p, i32p, u8p, f32p,
+        ctypes.c_int, ctypes.c_float, f32p]
+    lib.transform_batch.restype = None
+    lib.decode_cifar_records.argtypes = [u8p, i64, i64, u8p, i32p]
+    lib.decode_cifar_records.restype = None
+    lib.accumulate_sum.argtypes = [u8p, i64, i64, i64p]
+    lib.accumulate_sum.restype = None
+
+
+def available():
+    return _load() is not None
+
+
+def _ptr(arr, ctype):
+    return arr.ctypes.data_as(ctypes.POINTER(ctype))
+
+
+def transform_batch(images, crop, ys=None, xs=None, mirror=None, mean=None,
+                    scale=1.0):
+    """uint8 (N,C,H,W) -> float32 (N,C,crop,crop); native when possible.
+
+    mean: None | (C,) per-channel | (C,crop,crop) cropped mean image.
+    ys/xs: per-image int32 crop offsets (None -> 0: top-left/no crop).
+    mirror: per-image uint8 flags (None -> no flips).
+    """
+    lib = _load()
+    images = np.ascontiguousarray(images, np.uint8)
+    n, c, h, w = images.shape
+    if mean is not None:
+        mean = np.ascontiguousarray(mean, np.float32)
+        mean_kind = 1 if mean.ndim == 1 else 2
+        if mean.ndim == 3 and mean.shape != (c, crop, crop):
+            raise ValueError(f"mean shape {mean.shape} != {(c, crop, crop)}")
+    else:
+        mean_kind = 0
+    if lib is not None:
+        out = np.empty((n, c, crop, crop), np.float32)
+        ys_a = np.ascontiguousarray(ys, np.int32) if ys is not None else None
+        xs_a = np.ascontiguousarray(xs, np.int32) if xs is not None else None
+        mir = np.ascontiguousarray(mirror, np.uint8) \
+            if mirror is not None else None
+        lib.transform_batch(
+            _ptr(images, ctypes.c_uint8), n, c, h, w, crop,
+            _ptr(ys_a, ctypes.c_int32) if ys_a is not None else None,
+            _ptr(xs_a, ctypes.c_int32) if xs_a is not None else None,
+            _ptr(mir, ctypes.c_uint8) if mir is not None else None,
+            _ptr(mean, ctypes.c_float) if mean is not None else None,
+            mean_kind, ctypes.c_float(scale), _ptr(out, ctypes.c_float))
+        return out
+    # numpy fallback
+    out = np.empty((n, c, crop, crop), np.float32)
+    for i in range(n):
+        y0 = int(ys[i]) if ys is not None else 0
+        x0 = int(xs[i]) if xs is not None else 0
+        win = images[i, :, y0:y0 + crop, x0:x0 + crop].astype(np.float32)
+        if mirror is not None and mirror[i]:
+            win = win[:, :, ::-1]
+        out[i] = win
+    if mean_kind == 1:
+        out -= mean.reshape(1, c, 1, 1)
+    elif mean_kind == 2:
+        out -= mean
+    if scale != 1.0:
+        out *= scale
+    return out
+
+
+def decode_cifar_records(raw, record):
+    """Packed records -> (images uint8 (N, record-1), labels int32)."""
+    raw = np.ascontiguousarray(raw, np.uint8)
+    n = raw.size // record
+    lib = _load()
+    if lib is not None:
+        images = np.empty((n, record - 1), np.uint8)
+        labels = np.empty(n, np.int32)
+        lib.decode_cifar_records(_ptr(raw, ctypes.c_uint8), n, record,
+                                 _ptr(images, ctypes.c_uint8),
+                                 _ptr(labels, ctypes.c_int32))
+        return images, labels
+    recs = raw[:n * record].reshape(n, record)
+    return np.ascontiguousarray(recs[:, 1:]), recs[:, 0].astype(np.int32)
+
+
+def accumulate_sum(images, acc):
+    """Add sum-over-batch of uint8 (N,...) into int64 acc (...)."""
+    images = np.ascontiguousarray(images, np.uint8)
+    lib = _load()
+    if lib is not None and acc.flags.c_contiguous:
+        n = images.shape[0]
+        chw = images.size // max(n, 1)
+        if n:
+            lib.accumulate_sum(_ptr(images, ctypes.c_uint8), n, chw,
+                               _ptr(acc, ctypes.c_int64))
+        return acc
+    acc += images.astype(np.int64).sum(axis=0)
+    return acc

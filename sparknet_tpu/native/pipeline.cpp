@@ -1,0 +1,96 @@
+// Native host-side data pipeline kernels.
+//
+// The TPU-native analog of the reference's native data path: Caffe ran
+// decode/crop/mirror/mean in C++ worker threads (data_transformer.cpp:42-51,
+// base_data_layer.cpp prefetch InternalThreadEntry :70-101) because the
+// JVM/Python side could never keep the accelerator fed. Same economics here:
+// these loops release the GIL (plain C called via ctypes) so the Python
+// prefetch threads in sparknet_tpu.data.prefetch overlap transform with the
+// device step.
+//
+// Build: sparknet_tpu/native/__init__.py compiles this with g++ -O3 on first
+// use; pure-numpy fallbacks exist for every entry point.
+
+#include <cstdint>
+#include <cstring>
+
+#ifdef _OPENMP
+#include <omp.h>
+#endif
+
+extern "C" {
+
+// (n,c,h,w) uint8 -> (n,c,crop,crop) float32: per-image crop offsets
+// (ys/xs), optional horizontal mirror, mean subtraction, scale.
+// mean: nullptr | per-channel (mean_kind=1, c floats) | full CHW image at
+// the CROPPED size (mean_kind=2, c*crop*crop floats).
+void transform_batch(const uint8_t* in, int64_t n, int64_t c, int64_t h,
+                     int64_t w, int64_t crop, const int32_t* ys,
+                     const int32_t* xs, const uint8_t* mirror,
+                     const float* mean, int mean_kind, float scale,
+                     float* out) {
+  const int64_t in_img = c * h * w;
+  const int64_t out_img = c * crop * crop;
+#ifdef _OPENMP
+#pragma omp parallel for schedule(static)
+#endif
+  for (int64_t i = 0; i < n; ++i) {
+    const uint8_t* src = in + i * in_img;
+    float* dst = out + i * out_img;
+    const int64_t y0 = ys ? ys[i] : 0;
+    const int64_t x0 = xs ? xs[i] : 0;
+    const bool flip = mirror && mirror[i];
+    for (int64_t ch = 0; ch < c; ++ch) {
+      const uint8_t* splane = src + ch * h * w;
+      float* dplane = dst + ch * crop * crop;
+      const float* mplane =
+          mean_kind == 2 ? mean + ch * crop * crop : nullptr;
+      const float mchan = mean_kind == 1 ? mean[ch] : 0.0f;
+      for (int64_t y = 0; y < crop; ++y) {
+        const uint8_t* __restrict srow = splane + (y0 + y) * w + x0;
+        float* __restrict drow = dplane + y * crop;
+        // branch-free inner loops so gcc vectorizes the u8->f32 convert
+        if (!flip && mplane) {
+          const float* __restrict mrow = mplane + y * crop;
+          for (int64_t x = 0; x < crop; ++x)
+            drow[x] = ((float)srow[x] - mrow[x]) * scale;
+        } else if (!flip) {
+          for (int64_t x = 0; x < crop; ++x)
+            drow[x] = ((float)srow[x] - mchan) * scale;
+        } else if (mplane) {
+          const float* __restrict mrow = mplane + y * crop;
+          for (int64_t x = 0; x < crop; ++x)
+            drow[x] = ((float)srow[crop - 1 - x] - mrow[x]) * scale;
+        } else {
+          for (int64_t x = 0; x < crop; ++x)
+            drow[x] = ((float)srow[crop - 1 - x] - mchan) * scale;
+        }
+      }
+    }
+  }
+}
+
+// CIFAR binary records (1 label byte + c*h*w image bytes each) ->
+// planar images + labels (the CifarLoader.scala:66-86 inner loop).
+void decode_cifar_records(const uint8_t* raw, int64_t n, int64_t record,
+                          uint8_t* images, int32_t* labels) {
+  const int64_t img = record - 1;
+  for (int64_t i = 0; i < n; ++i) {
+    labels[i] = raw[i * record];
+    std::memcpy(images + i * img, raw + i * record + 1, img);
+  }
+}
+
+// uint8 (n,c,h,w) accumulate-sum into int64 (c,h,w) — the hot loop of
+// streaming mean-image computation (ComputeMean.scala:10-37).
+void accumulate_sum(const uint8_t* in, int64_t n, int64_t chw,
+                    int64_t* acc) {
+  for (int64_t i = 0; i < n; ++i) {
+    const uint8_t* img = in + i * chw;
+    for (int64_t j = 0; j < chw; ++j) acc[j] += img[j];
+  }
+}
+
+int native_abi_version() { return 1; }
+
+}  // extern "C"

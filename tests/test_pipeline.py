@@ -1,0 +1,207 @@
+"""Input pipeline: native C++ kernels vs numpy fallback, prefetch threads,
+tar-archive ImageNet loader — the reference's native data path
+(base_data_layer prefetch, data_transformer, ImageNetLoader.scala)."""
+
+import io
+import os
+import tarfile
+import time
+
+import numpy as np
+import pytest
+
+from sparknet_tpu import native
+from sparknet_tpu.data.transforms import (transform_train, transform_test,
+                                          subtract_mean, center_crop,
+                                          compute_mean)
+from sparknet_tpu.data.prefetch import PrefetchIterator
+from sparknet_tpu.data import cifar
+
+
+class TestNative:
+    def test_builds(self):
+        assert native.available(), "native pipeline failed to build"
+
+    def test_transform_matches_numpy(self):
+        rs = np.random.RandomState(0)
+        imgs = rs.randint(0, 256, (4, 3, 16, 16), dtype=np.uint8)
+        ys = rs.randint(0, 8, 4).astype(np.int32)
+        xs = rs.randint(0, 8, 4).astype(np.int32)
+        mirror = np.array([0, 1, 0, 1], np.uint8)
+        mean = rs.randn(3, 9, 9).astype(np.float32)
+        out = native.transform_batch(imgs, 9, ys=ys, xs=xs, mirror=mirror,
+                                     mean=mean, scale=0.5)
+        # hand-rolled reference
+        ref = np.empty_like(out)
+        for i in range(4):
+            win = imgs[i, :, ys[i]:ys[i] + 9, xs[i]:xs[i] + 9] \
+                .astype(np.float32)
+            if mirror[i]:
+                win = win[:, :, ::-1]
+            ref[i] = (win - mean) * 0.5
+        np.testing.assert_allclose(out, ref, atol=1e-5)
+
+    def test_transform_channel_mean_no_crop(self):
+        imgs = np.full((2, 3, 4, 4), 10, np.uint8)
+        out = native.transform_batch(imgs, 4, mean=np.array([1., 2., 3.]))
+        np.testing.assert_allclose(out[:, 1], 8.0)
+
+    def test_cifar_decode(self):
+        rs = np.random.RandomState(1)
+        raw = rs.randint(0, 256, 5 * 3073, dtype=np.uint8)
+        imgs, labels = native.decode_cifar_records(raw, 3073)
+        recs = raw.reshape(5, 3073)
+        np.testing.assert_array_equal(labels, recs[:, 0])
+        np.testing.assert_array_equal(imgs, recs[:, 1:])
+
+    def test_accumulate_sum(self):
+        rs = np.random.RandomState(2)
+        imgs = rs.randint(0, 256, (7, 3, 5, 5), dtype=np.uint8)
+        acc = np.zeros((3, 5, 5), np.int64)
+        native.accumulate_sum(imgs, acc)
+        np.testing.assert_array_equal(acc, imgs.astype(np.int64).sum(0))
+
+
+class TestFusedTransforms:
+    def test_train_fused_equals_composed(self):
+        rs = np.random.RandomState(0)
+        imgs = rs.randint(0, 256, (8, 3, 32, 32), dtype=np.uint8)
+        mean = rs.randn(3, 32, 32).astype(np.float32)
+        fused = transform_train(imgs, 24, mean=mean, mirror=False,
+                                rng=np.random.RandomState(7))
+        rng = np.random.RandomState(7)
+        ys = rng.randint(0, 9, size=8)
+        xs = rng.randint(0, 9, size=8)
+        for i in range(8):
+            win = imgs[i, :, ys[i]:ys[i] + 24, xs[i]:xs[i] + 24]
+            ref = subtract_mean(win[None], mean[:, 4:28, 4:28])[0]
+            np.testing.assert_allclose(fused[i], ref, atol=1e-5)
+
+    def test_test_fused_equals_composed(self):
+        rs = np.random.RandomState(0)
+        imgs = rs.randint(0, 256, (4, 3, 32, 32), dtype=np.uint8)
+        mean = rs.randn(3, 32, 32).astype(np.float32)
+        fused = transform_test(imgs, 24, mean=mean)
+        ref = subtract_mean(center_crop(imgs, 24), mean)
+        np.testing.assert_allclose(fused, ref, atol=1e-5)
+
+
+class TestPrefetch:
+    def test_order_and_completeness(self):
+        src = ({"i": i} for i in range(20))
+        got = [b["i"] for b in PrefetchIterator(src, depth=3)]
+        assert got == list(range(20))
+
+    def test_transform_applied_in_worker(self):
+        out = list(PrefetchIterator(iter([1, 2, 3]), depth=2,
+                                    transform=lambda x: x * 10))
+        assert out == [10, 20, 30]
+
+    def test_error_propagates(self):
+        def bad():
+            yield 1
+            raise RuntimeError("decode failed")
+        it = PrefetchIterator(bad(), depth=2)
+        assert next(it) == 1
+        with pytest.raises(RuntimeError, match="decode failed"):
+            next(it)
+            next(it)
+
+    def test_overlaps_slow_producer(self):
+        def slow():
+            for i in range(4):
+                time.sleep(0.05)
+                yield i
+        it = PrefetchIterator(slow(), depth=4)
+        time.sleep(0.25)          # producer fills the queue meanwhile
+        t0 = time.perf_counter()
+        assert list(it) == [0, 1, 2, 3]
+        assert time.perf_counter() - t0 < 0.15   # mostly prefetched
+
+    def test_close_stops_workers(self):
+        def endless():
+            i = 0
+            while True:
+                yield i
+                i += 1
+        it = PrefetchIterator(endless(), depth=2)
+        next(it)
+        it.close()   # must not hang
+
+
+class TestImageNetLoader:
+    @pytest.fixture()
+    def tar_dataset(self, tmp_path):
+        from PIL import Image
+        labels = {}
+        for a in range(2):
+            tpath = tmp_path / f"chunk{a}.tar"
+            with tarfile.open(tpath, "w") as tf:
+                for i in range(5):
+                    name = f"img_{a}_{i}"
+                    buf = io.BytesIO()
+                    arr = np.full((300, 200, 3), (a * 5 + i) * 10, np.uint8)
+                    Image.fromarray(arr).save(buf, format="JPEG")
+                    data = buf.getvalue()
+                    info = tarfile.TarInfo(name + ".JPEG")
+                    info.size = len(data)
+                    tf.addfile(info, io.BytesIO(data))
+                    labels[name] = a * 5 + i
+        # one undecodable entry (must be dropped silently)
+        with tarfile.open(tmp_path / "chunk1.tar", "a") as tf:
+            info = tarfile.TarInfo("img_bad.JPEG")
+            info.size = 4
+            tf.addfile(info, io.BytesIO(b"nope"))
+        labels["img_bad"] = 99
+        lpath = tmp_path / "train.txt"
+        lpath.write_text("".join(f"{k}.JPEG {v}\n"
+                                 for k, v in labels.items()))
+        return tmp_path, lpath
+
+    def test_stream_batches(self, tar_dataset):
+        from sparknet_tpu.data.imagenet import ImageNetLoader
+        root, lpath = tar_dataset
+        loader = ImageNetLoader(str(root / "chunk*.tar"),
+                                labels_path=str(lpath), batch_size=4,
+                                size=64, loop=False)
+        batches = list(loader)
+        # 10 good images, batch 4 -> 2 full batches, ragged tail dropped
+        assert len(batches) == 2
+        imgs, labs = batches[0]
+        assert imgs.shape == (4, 3, 64, 64) and imgs.dtype == np.uint8
+        assert labs.dtype == np.int32
+        # labels follow the map; bad image (label 99) never appears
+        all_labels = np.concatenate([b[1] for b in batches])
+        assert 99 not in all_labels
+
+    def test_sharding_partitions_archives(self, tar_dataset):
+        from sparknet_tpu.data.imagenet import ImageNetLoader
+        root, lpath = tar_dataset
+        l0 = ImageNetLoader(str(root / "chunk*.tar"), labels_path=str(lpath),
+                            batch_size=5, size=32, loop=False,
+                            shard_index=0, num_shards=2)
+        l1 = ImageNetLoader(str(root / "chunk*.tar"), labels_path=str(lpath),
+                            batch_size=5, size=32, loop=False,
+                            shard_index=1, num_shards=2)
+        lab0 = np.concatenate([b[1] for b in l0])
+        lab1 = np.concatenate([b[1] for b in l1])
+        assert set(lab0).isdisjoint(set(lab1))
+
+    def test_cifar_loader_uses_native(self, tmp_path):
+        rs = np.random.RandomState(0)
+        imgs = rs.randint(0, 256, (20, 3, 32, 32), dtype=np.uint8)
+        labs = rs.randint(0, 10, 20)
+        cifar.write_batch_file(tmp_path / "data_batch_1.bin", imgs, labs)
+        cifar.write_batch_file(tmp_path / "test_batch.bin", imgs[:5],
+                               labs[:5])
+        ds = cifar.CifarDataset(str(tmp_path), seed=0)
+        assert ds.train_images.shape == (20, 3, 32, 32)
+        # content preserved through write->native decode round trip
+        order = np.argsort(ds.train_labels, kind="stable")
+        assert set(ds.train_labels) == set(labs)
+
+
+def test_compute_mean_uses_native():
+    batches = [np.full((3, 1, 2, 2), v, np.uint8) for v in (0, 60)]
+    mean = compute_mean(iter(batches), (1, 2, 2))
+    assert np.allclose(mean, 30.0)
