@@ -44,11 +44,16 @@ def main():
     float(loss)  # value fetch = true sync (block_until_ready returns
     # immediately under the axon TPU tunnel, inflating throughput ~200x)
 
-    t0 = time.perf_counter()
-    for _ in range(ITERS):
-        loss = solver.train_step(batch)
-    float(loss)
-    dt = time.perf_counter() - t0
+    # best of 3 windows: the tunneled chip is shared, single windows vary 2x
+    best = None
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(ITERS):
+            loss = solver.train_step(batch)
+        float(loss)
+        dt = time.perf_counter() - t0
+        best = dt if best is None else min(best, dt)
+    dt = best
 
     img_per_sec = BATCH * ITERS / dt
     print(json.dumps({

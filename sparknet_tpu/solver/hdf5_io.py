@@ -1,0 +1,107 @@
+"""HDF5 checkpoint format — bit-compatible with the reference's layout.
+
+Net weights (net.cpp ToHDF5 :926-974):      /data/<layer_name>/<param_idx>
+Solver state (sgd_solver.cpp :278-297):     /iter, /learned_net,
+                                            /current_step, /history/<i>
+History datasets follow caffe's history_ vector order: slot-major — all
+learnable params' slot 0 (in net order), then all slot 1 (AdaDelta/Adam
+push their second round of blobs after the first, sgd_solver.cpp PreSolve /
+adadelta_solver.cpp), only layers that own their params.
+"""
+
+import numpy as np
+
+
+def _h5py():
+    import h5py
+    return h5py
+
+
+def owned_param_keys(net):
+    """[(layer_name, idx)] in net order — learnable-param order."""
+    keys = []
+    for lp, impl, bottoms, tops in net.layers:
+        for key in net.param_refs.get(lp.name, ()):
+            if key[0] == lp.name:
+                keys.append(key)
+    return keys
+
+
+def history_order(net, history):
+    """Yield (layer_name, param_idx, slot_idx) in caffe history_ order:
+    slot-major over learnable params."""
+    keys = owned_param_keys(net)
+    n_slots = max((len(history[l][i]) for l, i in keys), default=0)
+    for s in range(n_slots):
+        for (l, i) in keys:
+            if s < len(history[l][i]):
+                yield l, i, s
+
+
+def save_net_hdf5(path, net, params):
+    h5 = _h5py()
+    with h5.File(path, "w") as f:
+        data = f.create_group("data")
+        for lp, impl, bottoms, tops in net.layers:
+            owned = [k for k in net.param_refs.get(lp.name, ())
+                     if k[0] == lp.name]
+            g = data.create_group(lp.name)
+            for (lname, i) in owned:
+                g.create_dataset(str(i),
+                                 data=np.asarray(params[lname][i],
+                                                 np.float32))
+
+
+def load_net_hdf5(path, net, params):
+    """Copy matching datasets into params (CopyTrainedLayersFromHDF5:
+    layers matched by name, missing layers ignored)."""
+    h5 = _h5py()
+    import jax.numpy as jnp
+    out = {k: list(v) for k, v in params.items()}
+    with h5.File(path, "r") as f:
+        data = f["data"]
+        for lname in data:
+            if lname not in out:
+                continue
+            g = data[lname]
+            for i_str in g:
+                i = int(i_str)
+                if i < len(out[lname]):
+                    arr = np.asarray(g[i_str])
+                    out[lname][i] = jnp.asarray(
+                        arr.reshape(out[lname][i].shape),
+                        out[lname][i].dtype)
+    return out
+
+
+def save_state_hdf5(path, iter_, learned_net, net, history,
+                    current_step=0):
+    h5 = _h5py()
+    with h5.File(path, "w") as f:
+        f.create_dataset("iter", data=np.int64(iter_))
+        f.create_dataset("learned_net", data=learned_net)
+        f.create_dataset("current_step", data=np.int64(current_step))
+        g = f.create_group("history")
+        for n, (lname, i, s) in enumerate(history_order(net, history)):
+            g.create_dataset(str(n),
+                             data=np.asarray(history[lname][i][s],
+                                             np.float32))
+
+
+def load_state_hdf5(path, net, history):
+    """-> (iter, learned_net, new_history)."""
+    h5 = _h5py()
+    import jax.numpy as jnp
+    new_history = {k: [list(slot) for slot in v] for k, v in history.items()}
+    with h5.File(path, "r") as f:
+        it = int(np.asarray(f["iter"]))
+        learned = f["learned_net"][()]
+        if isinstance(learned, bytes):
+            learned = learned.decode()
+        g = f["history"]
+        for n, (lname, i, s) in enumerate(history_order(net, history)):
+            ref = new_history[lname][i][s]
+            arr = np.asarray(g[str(n)])
+            new_history[lname][i][s] = jnp.asarray(
+                arr.reshape(ref.shape), ref.dtype)
+    return it, learned, new_history

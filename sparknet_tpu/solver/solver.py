@@ -221,46 +221,70 @@ class Solver:
         return {k: v / n for k, v in sums.items()}
 
     # -- checkpointing (reference solver.cpp Snapshot :447-521) ------------
-    def snapshot(self, prefix=None):
+    def snapshot(self, prefix=None, format=None):
+        """Write weights + solver state. format: "binaryproto" (default) |
+        "hdf5", or taken from SolverParameter.snapshot_format (HDF5=0)."""
+        from . import hdf5_io
         prefix = prefix or self.param.snapshot_prefix
         d = os.path.dirname(prefix)
         if d:
             os.makedirs(d, exist_ok=True)
-        model_path = f"{prefix}_iter_{self.iter}.caffemodel"
-        state_path = f"{prefix}_iter_{self.iter}.solverstate"
-        net_proto = self.net.params_to_netproto(self.params, self.state)
-        wire.dump(net_proto, model_path)
-        ss = Message("SolverState", iter=self.iter, learned_net=model_path,
-                     current_step=0)
-        for lname in sorted(self.history):
-            for hs in self.history[lname]:
-                for h in hs:
-                    ss.history.append(array_to_blob(np.asarray(h)))
-        wire.dump(ss, state_path)
+        if format is None:
+            format = "hdf5" if int(self.param.snapshot_format) == 0 \
+                else "binaryproto"
+        if format == "hdf5":
+            model_path = f"{prefix}_iter_{self.iter}.caffemodel.h5"
+            state_path = f"{prefix}_iter_{self.iter}.solverstate.h5"
+            hdf5_io.save_net_hdf5(model_path, self.net, self.params)
+            hdf5_io.save_state_hdf5(state_path, self.iter, model_path,
+                                    self.net, self.history)
+        else:
+            model_path = f"{prefix}_iter_{self.iter}.caffemodel"
+            state_path = f"{prefix}_iter_{self.iter}.solverstate"
+            net_proto = self.net.params_to_netproto(self.params, self.state)
+            wire.dump(net_proto, model_path)
+            ss = Message("SolverState", iter=self.iter,
+                         learned_net=model_path, current_step=0)
+            # caffe history_ vector order: slot-major over net-ordered params
+            for lname, i, s in hdf5_io.history_order(self.net, self.history):
+                ss.history.append(
+                    array_to_blob(np.asarray(self.history[lname][i][s])))
+            wire.dump(ss, state_path)
         self.log(f"Snapshotting to {model_path}")
         return model_path, state_path
 
     def restore(self, state_path):
-        """Resume from a .solverstate (+ its learned_net .caffemodel)."""
+        """Resume from a .solverstate[.h5] (+ its learned_net weights)."""
+        from . import hdf5_io
+        if state_path.endswith(".h5"):
+            it, learned, self.history = hdf5_io.load_state_hdf5(
+                state_path, self.net, self.history)
+            self.iter = it
+            if learned and os.path.exists(learned):
+                self.load_weights(learned)
+            return
         ss = wire.load(state_path, "SolverState")
         self.iter = int(ss.iter)
         if ss.has("learned_net") and os.path.exists(ss.learned_net):
             self.load_weights(ss.learned_net)
         blobs = list(ss.history)
-        i = 0
-        for lname in sorted(self.history):
-            new_hs = []
-            for hs in self.history[lname]:
-                slot = []
-                for h in hs:
-                    arr = blob_to_array(blobs[i]).reshape(h.shape)
-                    slot.append(jnp.asarray(arr, h.dtype))
-                    i += 1
-                new_hs.append(slot)
-            self.history[lname] = new_hs
+        new_history = {k: [list(slot) for slot in v]
+                       for k, v in self.history.items()}
+        for n, (lname, i, s) in enumerate(
+                hdf5_io.history_order(self.net, self.history)):
+            ref = new_history[lname][i][s]
+            arr = blob_to_array(blobs[n]).reshape(ref.shape)
+            new_history[lname][i][s] = jnp.asarray(arr, ref.dtype)
+        self.history = new_history
 
     def load_weights(self, caffemodel_path):
-        """CopyTrainedLayersFrom equivalent — accepts stock .caffemodel."""
+        """CopyTrainedLayersFrom equivalent — accepts stock .caffemodel
+        (binaryproto) or .caffemodel.h5 (HDF5)."""
+        if caffemodel_path.endswith(".h5"):
+            from . import hdf5_io
+            self.params = hdf5_io.load_net_hdf5(caffemodel_path, self.net,
+                                                self.params)
+            return
         net_proto = wire.load(caffemodel_path, "NetParameter")
         self.params, self.state = self.net.load_netproto(
             net_proto, self.params, self.state)

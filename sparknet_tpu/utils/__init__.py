@@ -3,5 +3,7 @@
 from .signals import SignalPolicy
 from .metrics import MetricsLogger
 from .timing import Timer, StepTimer
+from .watchdog import Watchdog
 
-__all__ = ["SignalPolicy", "MetricsLogger", "Timer", "StepTimer"]
+__all__ = ["SignalPolicy", "MetricsLogger", "Timer", "StepTimer",
+           "Watchdog"]

@@ -1,0 +1,79 @@
+"""Training watchdog — failure detection the reference deliberately lacked.
+
+SparkNet set spark.task.maxFailures=1 (CifarApp.scala:38): ANY failure was
+fatal because native solver state couldn't survive Spark's lineage replay
+(SURVEY.md section 5). With explicit checkpoints the right behavior is the
+opposite: detect a stall (hung host callback, wedged device, dead peer) and
+act — snapshot, log, or kill the process so the job scheduler restarts it
+from the checkpoint.
+
+Also detects non-finite losses (the "model blew up" failure class) so long
+unattended runs stop burning chips on NaNs.
+"""
+
+import os
+import threading
+import time
+
+
+class Watchdog:
+    """Arm with expected step cadence; the training loop calls beat(loss).
+
+    on_stall(elapsed) is invoked from the monitor thread once per stall
+    detection (then re-arms); on_nan(loss) from beat(). Defaults: log via
+    print; kill_on_stall escalates to os._exit so an external supervisor
+    (k8s, xmanager) can reschedule from the last snapshot.
+    """
+
+    def __init__(self, stall_seconds=300.0, on_stall=None, on_nan=None,
+                 kill_on_stall=False, poll_seconds=None):
+        self.stall_seconds = float(stall_seconds)
+        self.on_stall = on_stall or (lambda dt: print(
+            f"[watchdog] no training step for {dt:.0f}s"))
+        self.on_nan = on_nan or (lambda loss: print(
+            f"[watchdog] non-finite loss {loss}"))
+        self.kill_on_stall = kill_on_stall
+        self.poll = poll_seconds or min(10.0, self.stall_seconds / 4)
+        self._last = time.monotonic()
+        self._stop = threading.Event()
+        self._thread = None
+        self.stalls = 0
+        self.nans = 0
+
+    def start(self):
+        self._last = time.monotonic()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="sparknet-watchdog")
+        self._thread.start()
+        return self
+
+    def beat(self, loss=None):
+        """Call once per training step (host-side, costs nothing)."""
+        self._last = time.monotonic()
+        if loss is not None:
+            v = float(loss)
+            if v != v or v in (float("inf"), float("-inf")):
+                self.nans += 1
+                self.on_nan(v)
+
+    def _run(self):
+        while not self._stop.wait(self.poll):
+            dt = time.monotonic() - self._last
+            if dt > self.stall_seconds:
+                self.stalls += 1
+                self.on_stall(dt)
+                if self.kill_on_stall:
+                    os._exit(42)
+                self._last = time.monotonic()   # re-arm
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False

@@ -144,6 +144,31 @@ class TestUtils:
         assert st.step_ms() >= 0
 
 
+class TestWatchdog:
+    def test_stall_detection(self):
+        import time as _t
+        from sparknet_tpu.utils import Watchdog
+        hits = []
+        wd = Watchdog(stall_seconds=0.1, poll_seconds=0.05,
+                      on_stall=lambda dt: hits.append(dt))
+        with wd:
+            _t.sleep(0.3)
+        assert wd.stalls >= 1 and hits
+
+    def test_beat_prevents_stall_and_nan_counts(self):
+        import time as _t
+        from sparknet_tpu.utils import Watchdog
+        wd = Watchdog(stall_seconds=0.3, poll_seconds=0.05,
+                      on_stall=lambda dt: None, on_nan=lambda v: None)
+        with wd:
+            for _ in range(6):
+                wd.beat(loss=1.0)
+                _t.sleep(0.05)
+            wd.beat(loss=float("nan"))
+        assert wd.stalls == 0
+        assert wd.nans == 1
+
+
 class TestCLI:
     def test_device_query(self, capsys):
         assert cli.main(["device_query"]) == 0
@@ -164,9 +189,10 @@ class TestCLI:
                          "--iterations", "3"]) == 0
         out = capsys.readouterr().out
         assert "Optimization done, iter=3" in out
-        # the trailing snapshot wrote restorable artifacts
-        assert (tmp_path / "quick_iter_3.caffemodel").exists()
-        assert (tmp_path / "quick_iter_3.solverstate").exists()
+        # the trailing snapshot wrote restorable artifacts — in HDF5,
+        # because the stock solver says "snapshot_format: HDF5"
+        assert (tmp_path / "quick_iter_3.caffemodel.h5").exists()
+        assert (tmp_path / "quick_iter_3.solverstate.h5").exists()
         assert cli.main(["time", "--model", model_path,
                          "--input-shape", "data=100,3,32,32",
                          "--iterations", "2"]) == 0

@@ -584,3 +584,52 @@ class TestLosses:
                               accuracy_param=dict(top_k=2))
         (acc,) = layer.apply([], [x, lab], False, None)
         np.testing.assert_allclose(acc, 2.0 / 3.0, rtol=1e-6)
+
+
+class TestAttention:
+    """The long-context extension layer (ops/attention.py): shape, causal
+    masking, and gradient correctness vs central differences."""
+
+    def _layer(self, b=2, s=8, e=12, heads=3, causal=True):
+        return make_layer("Attention", [(b, s, e)],
+                          attention_param=dict(num_heads=heads,
+                                               causal=causal))
+
+    def test_forward_shape_and_causality(self):
+        layer, _ = self._layer()
+        params = init_params(layer)
+        x = jnp.asarray(RNG.randn(2, 8, 12), jnp.float32)
+        (y,) = layer.apply(params, [x], False, None)
+        assert y.shape == (2, 8, 12)
+        # causality: perturbing a LATER position must not change earlier rows
+        x2 = np.asarray(x).copy()
+        x2[:, 5] += 10.0
+        (y2,) = layer.apply(params, [jnp.asarray(x2)], False, None)
+        np.testing.assert_allclose(np.asarray(y)[:, :5],
+                                   np.asarray(y2)[:, :5], atol=1e-5)
+        assert not np.allclose(np.asarray(y)[:, 5:], np.asarray(y2)[:, 5:])
+
+    def test_gradient_wrt_input(self):
+        layer, _ = self._layer(b=1, s=4, e=6, heads=2)
+        params = init_params(layer)
+        x = 0.5 * RNG.randn(1, 4, 6)
+
+        def f(v):
+            (y,) = layer.apply(params, [v], True, None)
+            return jnp.sum(y * jnp.asarray(WEIGHTS_A[: y.size]
+                                           .reshape(y.shape)))
+        check_grad(f, x, step=1e-3, tol=2e-2)
+
+    def test_gradient_wrt_qkv_weight(self):
+        layer, _ = self._layer(b=1, s=4, e=6, heads=2)
+        params = init_params(layer)
+        x = jnp.asarray(0.5 * RNG.randn(1, 4, 6), jnp.float32)
+
+        def f(w):
+            (y,) = layer.apply([w] + params[1:], [x], True, None)
+            return jnp.sum(y * jnp.asarray(WEIGHTS_A[: y.size]
+                                           .reshape(y.shape)))
+        check_grad(f, np.asarray(params[0]), step=1e-3, tol=2e-2)
+
+
+WEIGHTS_A = np.linspace(-1.0, 1.0, 4096).astype(np.float32)

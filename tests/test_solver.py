@@ -272,6 +272,33 @@ class TestSolverEndToEnd:
         np.testing.assert_allclose(s.history["fc1"][0][0],
                                    s2.history["fc1"][0][0], rtol=1e-5)
 
+    @pytest.mark.parametrize("stype", ["SGD", "Adam"])  # 1-slot and 2-slot
+    def test_hdf5_snapshot_restore_roundtrip(self, stype, tmp_path):
+        """HDF5 format (reference snapshot_format: HDF5, the cifar10_full
+        solver default): /data/<layer>/<i> weights, slot-major /history."""
+        sp = make_sp(base_lr=0.01, lr_policy="fixed", type=stype,
+                     momentum=0.9, random_seed=7, snapshot_format=0)
+        s = Solver(sp, net_param=_mlp_net(), log_fn=None)
+        data = _toy_batches(16)
+        for _ in range(4):
+            s.train_step(next(data))
+        model_path, state_path = s.snapshot(str(tmp_path / "h5snap"))
+        assert model_path.endswith(".caffemodel.h5")
+        # layout check: /data/<layer>/<idx> groups exist
+        import h5py
+        with h5py.File(model_path) as f:
+            assert "fc1" in f["data"] and "0" in f["data"]["fc1"]
+        s2 = Solver(sp, net_param=_mlp_net(), log_fn=None)
+        s2.restore(state_path)
+        assert s2.iter == 4
+        b = next(data)
+        l1 = float(s.train_step(dict(b)))
+        l2 = float(s2.train_step(dict(b)))
+        assert l1 == pytest.approx(l2, rel=1e-5)
+        for i in range(len(s.history["fc1"][0])):
+            np.testing.assert_allclose(s.history["fc1"][0][i],
+                                       s2.history["fc1"][0][i], rtol=1e-5)
+
     def test_solver_prototxt_from_reference(self):
         from sparknet_tpu.proto import text_format
         sp = text_format.load(
