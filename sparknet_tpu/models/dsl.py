@@ -119,10 +119,10 @@ def SoftmaxLayer(name, bottoms):
 
 
 def AttentionLayer(name, bottoms, num_heads, head_dim=None, causal=False,
-                   ring=False):
+                   ring=False, flash=False):
     """sparknet_tpu extension for the long-context path (see
-    parallel.ring_attention)."""
-    ap = dict(num_heads=num_heads, causal=causal, ring=ring)
+    parallel.ring_attention, ops.pallas_attention)."""
+    ap = dict(num_heads=num_heads, causal=causal, ring=ring, flash=flash)
     if head_dim is not None:
         ap["head_dim"] = head_dim
     return _base("Attention", name, bottoms, attention_param=ap)

@@ -33,6 +33,7 @@ class Attention(Layer):
             else self.embed // self.num_heads
         self.causal = bool(p.causal)
         self.ring = bool(p.ring)
+        self.flash = bool(p.flash)
         self.inner = self.num_heads * self.head_dim
 
     def param_shapes(self):
@@ -61,6 +62,9 @@ class Attention(Layer):
         seq_axis = context.axis("seq")
         if self.ring and seq_axis is not None:
             o = ring_attention(q, k, v, seq_axis, causal=self.causal)
+        elif self.flash and s % 128 == 0:
+            from .pallas_attention import flash_attention
+            o = flash_attention(q, k, v, self.causal)
         else:
             o = dense_attention(q, k, v, causal=self.causal)
         o = jnp.moveaxis(o, 2, 1).reshape(b, s, self.inner)

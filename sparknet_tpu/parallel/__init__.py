@@ -22,6 +22,7 @@ __all__ = [
     "make_mesh", "mesh_axis_size", "distributed_init", "local_batch_slice",
     "axis_context", "current_axes", "context",
     "DataParallelSolver", "LocalSGDSolver", "shard_batch",
+    "GSPMDSolver", "default_param_rule",
     "ring_attention", "ulysses_attention", "sequence_sharded_apply",
 ]
 
@@ -34,6 +35,7 @@ _EXPORTS = {
     "axis_context": "context", "current_axes": "context",
     "DataParallelSolver": "data_parallel", "LocalSGDSolver": "data_parallel",
     "shard_batch": "data_parallel",
+    "GSPMDSolver": "gspmd", "default_param_rule": "gspmd",
     "ring_attention": "ring", "ulysses_attention": "ring",
     "sequence_sharded_apply": "ring",
 }

@@ -101,6 +101,11 @@ class Solver:
 
     # -- compiled steps ----------------------------------------------------
     def _build_train_step(self):
+        return jax.jit(self._train_step_fn(), donate_argnums=(0, 1, 2))
+
+    def _train_step_fn(self):
+        """The pure (uncompiled) train step — subclasses re-jit it with
+        sharding annotations (parallel.gspmd) or wrap it in shard_map."""
         iter_size = int(self.param.iter_size)
         net, updater, lr_fn = self.net, self.updater, self.lr_fn
 
@@ -132,7 +137,7 @@ class Solver:
             params, history = updater(params, grads, history, rate, it)
             return params, state, history, loss
 
-        return jax.jit(step, donate_argnums=(0, 1, 2))
+        return step
 
     def _build_eval_step(self):
         net = self.test_net
