@@ -3,12 +3,17 @@
 Reference baseline (BASELINE.md): stock Caffe trains CaffeNet at 256-image
 batches in 26.5 s / 20 iters on a K40 (~193 img/s), 19.2 s with cuDNN
 (~267 img/s). We time the same workload — batch 256, 227x227, full
-forward+backward+momentum-SGD update — as ONE jitted XLA step on whatever
-chip is present, mixed precision (fp32 params, bf16 activations: the ops
-cast weights to the activation dtype, so feeding bf16 drives the MXU the
-way cuDNN's fp32 path drove the K40's SMs).
+forward+backward+momentum-SGD update — as ONE jitted XLA step, mixed
+precision (fp32 params, bf16 activations driving the MXU).
 
-Prints one JSON line: {"metric", "value", "unit", "vs_baseline"}.
+stdout: ONE JSON line {"metric", "value", "unit", "vs_baseline"} — the
+synthetic-fed headline number (input pipeline excluded, like the reference's
+in-memory LMDB page cache).
+stderr: supplementary rows ("#BENCH {...}"): host-fed throughput (uint8
+256x256 host batches through the native crop/mirror/mean transform +
+double-buffered prefetch — the honest end-to-end number), a batch-512
+variant, GoogLeNet, and MFU accounting. All rows also land in
+bench_details.json.
 """
 
 import json
@@ -18,53 +23,220 @@ import time
 import numpy as np
 
 BASELINE_IMG_PER_SEC = 267.0   # K40 + cuDNN, caffe/docs/performance_hardware.md:19-25
-BATCH = 256
 WARMUP = 3
 ITERS = 20
+
+# bf16 peak FLOP/s by device kind (public TPU specs; MFU denominators)
+_PEAK = {
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12, "TPU v5e": 197e12,
+    "TPU v5": 459e12, "TPU v5p": 459e12,
+    "TPU v6 lite": 918e12, "TPU v6e": 918e12,
+}
+
+
+def model_train_flops_per_image(solver):
+    """Analytic MXU FLOPs: 2*MACs forward for conv/fc, x3 for training
+    (grad wrt activations + grad wrt weights each re-run the matmuls).
+    Elementwise/LRN/pool FLOPs are excluded — this is the standard MFU
+    numerator, so the reported MFU slightly *understates* utilization."""
+    net = solver.net
+    fwd = 0
+    batch = None
+    for lp, impl, bottoms, tops in net.layers:
+        if lp.type == "Convolution":
+            out = net.blob_shapes[tops[0]]
+            n, co, ho, wo = out
+            batch = batch or n
+            ci = net.blob_shapes[bottoms[0]][1]
+            cp = lp.convolution_param
+            ks = [int(x) for x in cp.kernel_size]
+            if ks:
+                kh = kw = ks[0]
+            else:                        # DSL nets use kernel_h/kernel_w
+                kh = int(cp.kernel_h)
+                kw = int(cp.kernel_w)
+            group = int(cp.group) if cp.has("group") else 1
+            fwd += 2 * n * co * ho * wo * (ci // group) * kh * kw
+        elif lp.type == "InnerProduct":
+            out = net.blob_shapes[tops[0]]
+            n = out[0]
+            batch = batch or n
+            cin = int(np.prod(net.blob_shapes[bottoms[0]][1:]))
+            fwd += 2 * n * out[1] * cin
+    return 3 * fwd // (batch or 1)
+
+
+def _time_windows(step, sync, iters=ITERS, windows=3):
+    # best of N windows: the tunneled chip is shared, single windows vary 2x
+    best = None
+    for _ in range(windows):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = step()
+        sync(out)   # value fetch = true sync (block_until_ready returns
+        # immediately under the axon TPU tunnel, inflating throughput ~200x)
+        dt = time.perf_counter() - t0
+        best = dt if best is None else min(best, dt)
+    return best
+
+
+def _mk_solver(net_param, base_lr=0.01):
+    from sparknet_tpu.proto import Message
+    from sparknet_tpu.solver.solver import Solver
+    sp = Message("SolverParameter", base_lr=base_lr, lr_policy="fixed",
+                 momentum=0.9, weight_decay=0.0005, display=0, random_seed=0)
+    return Solver(sp, net_param=net_param)
+
+
+def bench_synthetic(name, net_param, batch_size, shape, classes, peak):
+    import jax.numpy as jnp
+    solver = _mk_solver(net_param)
+    rs = np.random.RandomState(0)
+    data = jnp.asarray(rs.randn(batch_size, *shape), jnp.bfloat16)
+    label = jnp.asarray(rs.randint(0, classes, batch_size), jnp.int32)
+    batch = {"data": data, "label": label}
+    for _ in range(WARMUP):
+        loss = solver.train_step(batch)
+    float(loss)
+    dt = _time_windows(lambda: solver.train_step(batch), float)
+    img_s = batch_size * ITERS / dt
+    flops = model_train_flops_per_image(solver)
+    row = {"model": name, "mode": "synthetic", "batch": batch_size,
+           "images_per_sec": round(img_s, 2),
+           "train_gflops_per_image": round(flops / 1e9, 2),
+           "model_tflops_per_sec": round(img_s * flops / 1e12, 2)}
+    if peak:
+        row["mfu"] = round(img_s * flops / peak, 4)
+    return row, solver
+
+
+def bench_hostfed(name, solver, batch_size, src_size, crop, classes, peak):
+    """uint8 source batches -> native random-crop/mirror/mean transform in a
+    prefetch worker -> device_put -> step. The input pipeline the synthetic
+    row excludes; overlap should keep it within ~15% (VERDICT #3)."""
+    import jax
+    import jax.numpy as jnp
+    from sparknet_tpu.data.prefetch import PrefetchIterator
+    from sparknet_tpu import native
+
+    rs = np.random.RandomState(0)
+    pool = rs.randint(0, 256, (batch_size * 2, 3, src_size, src_size),
+                      dtype=np.uint8)
+    labels = rs.randint(0, classes, batch_size * 2).astype(np.int32)
+    mean = np.full((3,), 120.0, np.float32)
+    prng = np.random.RandomState(1)
+
+    def produce_host():
+        n = len(pool)
+        while True:
+            idx = prng.randint(0, n - batch_size + 1)
+            imgs = pool[idx:idx + batch_size]
+            ys = prng.randint(0, src_size - crop + 1, batch_size) \
+                .astype(np.int32)
+            xs = prng.randint(0, src_size - crop + 1, batch_size) \
+                .astype(np.int32)
+            flips = prng.randint(0, 2, batch_size).astype(np.uint8)
+            f32 = native.transform_batch(imgs, crop, ys=ys, xs=xs,
+                                         mirror=flips, mean=mean)
+            yield f32, labels[idx:idx + batch_size]
+
+    def produce():
+        for f32, labs in produce_host():
+            yield {"data": jax.device_put(jnp.asarray(f32, jnp.bfloat16)),
+                   "label": jnp.asarray(labs)}
+
+    # host transform alone (decode-side ceiling, no device in the loop)
+    gen = produce_host()
+    next(gen)
+    t0 = time.perf_counter()
+    for _ in range(5):
+        next(gen)
+    host_img_s = 5 * batch_size / (time.perf_counter() - t0)
+
+    it = PrefetchIterator(produce(), depth=3)
+    try:
+        for _ in range(WARMUP):
+            loss = solver.train_step(next(it))
+        float(loss)
+        dt = _time_windows(lambda: solver.train_step(next(it)), float)
+    finally:
+        it.close()
+    img_s = batch_size * ITERS / dt
+    flops = model_train_flops_per_image(solver)
+    row = {"model": name, "mode": "host_fed", "batch": batch_size,
+           "images_per_sec": round(img_s, 2),
+           "host_transform_images_per_sec": round(host_img_s, 2)}
+    if peak:
+        row["mfu"] = round(img_s * flops / peak, 4)
+    if img_s < 0.5 * host_img_s:
+        # on this rig the chip is remote (axon tunnel): every step ships the
+        # batch over the tunnel at ~MB/s, so end-to-end is transfer-bound,
+        # not pipeline-bound. The two numbers above separate the stories.
+        row["note"] = ("end-to-end limited by host->device transfer "
+                       "(remote-tunnel TPU); host transform itself "
+                       "sustains the rate above")
+    return row
 
 
 def main():
     import jax
-    import jax.numpy as jnp
     from sparknet_tpu.models import zoo
-    from sparknet_tpu.proto import Message
-    from sparknet_tpu.solver.solver import Solver
 
-    sp = Message("SolverParameter", base_lr=0.01, lr_policy="fixed",
-                 momentum=0.9, weight_decay=0.0005, display=0, random_seed=0)
-    solver = Solver(sp, net_param=zoo.caffenet(batch_size=BATCH,
-                                               num_classes=1000))
-    rs = np.random.RandomState(0)
-    data = jnp.asarray(rs.randn(BATCH, 3, 227, 227), jnp.bfloat16)
-    label = jnp.asarray(rs.randint(0, 1000, BATCH), jnp.int32)
-    batch = {"data": data, "label": label}
+    # persistent compile cache: repeat bench runs skip the (minutes-long)
+    # XLA compiles; keyed by HLO so code changes still recompile
+    try:
+        jax.config.update("jax_compilation_cache_dir",
+                          "/tmp/sparknet_jax_cache")
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
+    except Exception:
+        pass
 
-    for _ in range(WARMUP):
-        loss = solver.train_step(batch)
-    float(loss)  # value fetch = true sync (block_until_ready returns
-    # immediately under the axon TPU tunnel, inflating throughput ~200x)
+    dev = jax.devices()[0]
+    peak = next((v for k, v in _PEAK.items()
+                 if k.lower() in dev.device_kind.lower()), None)
+    rows = []
 
-    # best of 3 windows: the tunneled chip is shared, single windows vary 2x
-    best = None
-    for _ in range(3):
-        t0 = time.perf_counter()
-        for _ in range(ITERS):
-            loss = solver.train_step(batch)
-        float(loss)
-        dt = time.perf_counter() - t0
-        best = dt if best is None else min(best, dt)
-    dt = best
+    # headline: CaffeNet batch 256, synthetic-fed (the reference workload)
+    head, solver = bench_synthetic(
+        "caffenet", zoo.caffenet(batch_size=256, num_classes=1000),
+        256, (3, 227, 227), 1000, peak)
+    rows.append(head)
 
-    img_per_sec = BATCH * ITERS / dt
-    print(json.dumps({
+    # honest row: same model+batch fed from uint8 host data via the
+    # native transform + prefetch pipeline
+    rows.append(bench_hostfed("caffenet", solver, 256, 256, 227, 1000,
+                              peak))
+    del solver
+
+    # batch-512 variant: bigger MXU tiles amortize the small spatial dims
+    row512, s512 = bench_synthetic(
+        "caffenet", zoo.caffenet(batch_size=512, num_classes=1000),
+        512, (3, 227, 227), 1000, peak)
+    rows.append(row512)
+    del s512
+
+    # GoogLeNet (the reference's third headline model family)
+    rowg, sg = bench_synthetic(
+        "googlenet", zoo.googlenet(batch_size=128, num_classes=1000),
+        128, (3, 224, 224), 1000, peak)
+    rows.append(rowg)
+    del sg
+
+    head_out = {
         "metric": "caffenet_train_throughput",
-        "value": round(img_per_sec, 2),
+        "value": head["images_per_sec"],
         "unit": "images/sec",
-        "vs_baseline": round(img_per_sec / BASELINE_IMG_PER_SEC, 3),
-    }))
-    print(f"# {ITERS} iters x {BATCH} imgs in {dt:.2f}s on "
-          f"{jax.devices()[0].platform}; loss={float(loss):.4f}",
-          file=sys.stderr)
+        "vs_baseline": round(head["images_per_sec"] / BASELINE_IMG_PER_SEC,
+                             3),
+    }
+    print(json.dumps(head_out))
+    detail = {"device": dev.device_kind, "platform": dev.platform,
+              "peak_bf16_flops": peak, "rows": rows}
+    for r in rows:
+        print("#BENCH " + json.dumps(r), file=sys.stderr)
+    with open("bench_details.json", "w") as f:
+        json.dump(detail, f, indent=1)
 
 
 if __name__ == "__main__":

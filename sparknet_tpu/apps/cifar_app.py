@@ -36,9 +36,15 @@ class CifarApp:
     executor count, CifarApp.scala:34)."""
 
     def __init__(self, num_workers=None, data_dir=None, prototxt_dir=None,
-                 strategy="local_sgd", tau=10, log_path=None, seed=None):
+                 strategy="local_sgd", tau=10, log_path=None, seed=None,
+                 metrics_path=None):
         self.t0 = time.time()
         self.logf = open(log_path, "w") if log_path else None
+        self.metrics_path = metrics_path
+        self.rng = np.random.RandomState(seed)
+        self._train_f32 = None
+        from ..parallel import distributed_init
+        distributed_init()      # no-op single-process (DEPLOY.md)
         mesh = make_mesh({"data": num_workers if num_workers else -1})
         self.num_workers = mesh.shape["data"]
         self.strategy = strategy
@@ -88,10 +94,17 @@ class CifarApp:
 
     # -- data feeds ---------------------------------------------------------
     def _train_arrays(self, n_images):
-        imgs = self.data.train_images.astype(np.float32) - self.data.mean_image
-        labs = self.data.train_labels
-        idx = np.random.randint(0, len(imgs) - n_images + 1)
-        return imgs[idx:idx + n_images], labs[idx:idx + n_images]
+        if self._train_f32 is None:     # mean-subtract once, not per round
+            self._train_f32 = self.data.train_images.astype(np.float32) \
+                - self.data.mean_image
+        imgs, labs = self._train_f32, self.data.train_labels
+        n = len(imgs)
+        # random contiguous window (MinibatchSampler.scala:20-21), modular
+        # so a request larger than the dataset wraps instead of raising
+        # (e.g. local_sgd tau*batch*workers on a small set)
+        start = self.rng.randint(0, n)
+        idx = (start + np.arange(n_images)) % n
+        return imgs[idx], labs[idx]
 
     def _tau_batches(self, tau):
         """(tau, workers*batch, ...) arrays: each worker's contiguous window
@@ -120,26 +133,70 @@ class CifarApp:
         for i in range(0, len(imgs) // bs * bs, bs):
             yield {"data": imgs[i:i + bs], "label": labs[i:i + bs]}
 
-    # -- the driver loop (CifarApp.scala:92-135) ---------------------------
-    def run(self, num_rounds=100, test_every=10):
-        for r in range(num_rounds):
-            if r % test_every == 0:
-                self.log("testing")
-                n = min(len(self.data.test_images) // self._test_batch_size(),
-                        100)
-                scores = self.solver.test(self._test_iter(), num_iters=n)
-                for k, v in scores.items():
-                    self.log(f"round {r}: test {k} = "
-                             f"{np.asarray(v).mean():.4f}")
-            self.log("broadcasting weights & running workers")
+    def _round_stream(self):
+        """Infinite per-round batch generator — runs in the prefetch worker
+        so host-side window sampling overlaps the device round (the
+        base_data_layer.cpp:70-101 double-buffering, loader-push style)."""
+        while True:
             if self.strategy == "local_sgd":
-                loss = self.solver.train_round(
-                    self._tau_batches(self.solver.tau))
+                yield self._tau_batches(self.solver.tau)
             else:
                 imgs, labs = self._train_arrays(
                     TRAIN_BATCH * self.num_workers)
-                loss = self.solver.train_step({"data": imgs, "label": labs})
-            self.log(f"round {r}: loss = {float(loss):.4f}")
+                yield {"data": imgs, "label": labs}
+
+    # -- the driver loop (CifarApp.scala:92-135) ---------------------------
+    def run(self, num_rounds=100, test_every=10, stall_seconds=600.0):
+        from ..data.prefetch import PrefetchIterator
+        from ..utils.watchdog import Watchdog
+        from ..utils.metrics import MetricsLogger
+
+        metrics = MetricsLogger(path=self.metrics_path) \
+            if self.metrics_path else None
+        steps_per_round = self.solver.tau \
+            if self.strategy == "local_sgd" else 1
+        imgs_per_round = TRAIN_BATCH * self.num_workers * steps_per_round
+        wd = Watchdog(stall_seconds=stall_seconds,
+                      on_stall=lambda dt: self.log(
+                          f"WATCHDOG: no round finished in {dt:.0f}s"),
+                      on_nan=lambda v: self.log(f"WATCHDOG: loss = {v}"))
+        batches = PrefetchIterator(self._round_stream(), depth=2)
+        try:
+            with wd:
+                for r in range(num_rounds):
+                    if r % test_every == 0:
+                        self.log("testing")
+                        n = min(len(self.data.test_images)
+                                // self._test_batch_size(), 100)
+                        scores = self.solver.test(self._test_iter(),
+                                                  num_iters=n)
+                        for k, v in scores.items():
+                            v = float(np.asarray(v).mean())
+                            self.log(f"round {r}: test {k} = {v:.4f}")
+                            if metrics:
+                                metrics.log("test", round=r, metric=k,
+                                            value=v)
+                    self.log("broadcasting weights & running workers")
+                    rt0 = time.perf_counter()
+                    if self.strategy == "local_sgd":
+                        loss = self.solver.train_round(next(batches))
+                    else:
+                        loss = self.solver.train_step(next(batches))
+                    loss = float(loss)
+                    dt = time.perf_counter() - rt0
+                    wd.beat(loss)
+                    self.log(f"round {r}: loss = {loss:.4f}")
+                    if metrics:
+                        metrics.log("round", round=r, loss=loss,
+                                    iter=self.solver.iter,
+                                    lr=float(self.solver.lr_fn(
+                                        self.solver.iter)),
+                                    images_per_s=round(imgs_per_round
+                                                       / max(dt, 1e-9), 1))
+        finally:
+            batches.close()
+            if metrics:
+                metrics.close()
         return self.solver
 
 

@@ -27,9 +27,12 @@ BATCH = 256
 class ImageNetApp:
     def __init__(self, num_workers=None, train_source=None, test_source=None,
                  num_classes=1000, strategy="local_sgd", tau=50, batch=BATCH,
-                 log_path=None, seed=0):
+                 log_path=None, seed=0, metrics_path=None):
         self.t0 = time.time()
         self.logf = open(log_path, "w") if log_path else None
+        self.metrics_path = metrics_path
+        from ..parallel import distributed_init
+        distributed_init()      # no-op single-process (DEPLOY.md)
         mesh = make_mesh({"data": num_workers if num_workers else -1})
         self.num_workers = mesh.shape["data"]
         self.strategy = strategy
@@ -93,40 +96,83 @@ class ImageNetApp:
         labels = np.concatenate(labs)[:n]
         return prep(images), labels
 
-    # -- driver loop (ImageNetApp.scala:100-182) ---------------------------
-    def run(self, num_rounds=10, test_every=10, test_iters=4):
-        for r in range(num_rounds):
-            if test_every and r % test_every == 0 and self.test_source:
-                def it():
-                    bs = self.batch * (1 if self.strategy == "local_sgd"
-                                       else self.num_workers)
-                    while True:
-                        d, l = self._collect(self.test_source, bs,
-                                             self._prep_test)
-                        yield {"data": d, "label": l}
-                scores = self.solver.test(it(), num_iters=test_iters)
-                for k, v in scores.items():
-                    self.log(f"round {r}: test {k} = "
-                             f"{np.asarray(v).mean():.4f}")
+    def _round_stream(self):
+        """Per-round batches, produced in the prefetch worker: JPEG-decoded
+        source batches -> native crop/mirror/mean transform, overlapping the
+        device round (base_data_layer.cpp:70-101 economics)."""
+        while True:
             if self.strategy == "local_sgd":
                 tau = self.solver.tau
                 d, l = self._collect(
                     self.train_source, tau * self.batch * self.num_workers,
                     self._prep_train)
-                batches = {
+                yield {
                     "data": d.reshape(self.num_workers, tau, self.batch,
                                       3, CROP, CROP)
                     .transpose(1, 0, 2, 3, 4, 5)
                     .reshape(tau, -1, 3, CROP, CROP),
                     "label": l.reshape(self.num_workers, tau, self.batch)
                     .transpose(1, 0, 2).reshape(tau, -1)}
-                loss = self.solver.train_round(batches)
             else:
                 d, l = self._collect(self.train_source,
                                      self.batch * self.num_workers,
                                      self._prep_train)
-                loss = self.solver.train_step({"data": d, "label": l})
-            self.log(f"round {r}: loss = {float(loss):.4f}")
+                yield {"data": d, "label": l}
+
+    # -- driver loop (ImageNetApp.scala:100-182) ---------------------------
+    def run(self, num_rounds=10, test_every=10, test_iters=4,
+            stall_seconds=1200.0):
+        from ..data.prefetch import PrefetchIterator
+        from ..utils.watchdog import Watchdog
+        from ..utils.metrics import MetricsLogger
+
+        metrics = MetricsLogger(path=self.metrics_path) \
+            if self.metrics_path else None
+        steps = self.solver.tau if self.strategy == "local_sgd" else 1
+        imgs_per_round = self.batch * self.num_workers * steps
+        wd = Watchdog(stall_seconds=stall_seconds,
+                      on_stall=lambda dt: self.log(
+                          f"WATCHDOG: no round finished in {dt:.0f}s"),
+                      on_nan=lambda v: self.log(f"WATCHDOG: loss = {v}"))
+        batches = PrefetchIterator(self._round_stream(), depth=2)
+        try:
+            with wd:
+                for r in range(num_rounds):
+                    if test_every and r % test_every == 0 and \
+                            self.test_source:
+                        def it():
+                            bs = self.batch * (
+                                1 if self.strategy == "local_sgd"
+                                else self.num_workers)
+                            while True:
+                                d, l = self._collect(self.test_source, bs,
+                                                     self._prep_test)
+                                yield {"data": d, "label": l}
+                        scores = self.solver.test(it(), num_iters=test_iters)
+                        for k, v in scores.items():
+                            v = float(np.asarray(v).mean())
+                            self.log(f"round {r}: test {k} = {v:.4f}")
+                            if metrics:
+                                metrics.log("test", round=r, metric=k,
+                                            value=v)
+                    rt0 = time.perf_counter()
+                    if self.strategy == "local_sgd":
+                        loss = self.solver.train_round(next(batches))
+                    else:
+                        loss = self.solver.train_step(next(batches))
+                    loss = float(loss)
+                    dt = time.perf_counter() - rt0
+                    wd.beat(loss)
+                    self.log(f"round {r}: loss = {loss:.4f}")
+                    if metrics:
+                        metrics.log("round", round=r, loss=loss,
+                                    iter=self.solver.iter,
+                                    images_per_s=round(
+                                        imgs_per_round / max(dt, 1e-9), 1))
+        finally:
+            batches.close()
+            if metrics:
+                metrics.close()
         return self.solver
 
 

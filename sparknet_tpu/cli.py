@@ -39,8 +39,8 @@ def cmd_device_query(args):
 
 
 def _make_data_iter(net, seed=0):
-    """Synthetic batch stream matching the net's feed shapes (stands in for
-    LMDB: the stock prototxt data sources are host-side concerns)."""
+    """Synthetic batch stream matching the net's feed shapes — the fallback
+    when a prototxt's DB source doesn't exist on this machine."""
     import numpy as np
     rs = np.random.RandomState(seed)
     shapes = net.feed_shapes()
@@ -55,6 +55,21 @@ def _make_data_iter(net, seed=0):
                     batch[name] = rs.randn(*shape).astype(np.float32)
             yield batch
     return gen()
+
+
+def _real_feeds(train_np, test_np, base_dir, seed=None):
+    """Open the LMDB sources the net's Data layers name, when they exist.
+    Returns (train_shapes, train_src, test_shapes, test_src) with None
+    entries where no real source is available."""
+    from .graph.compiler import TRAIN, TEST
+    from .data.db_source import build_db_feed
+    train_shapes, train_src = build_db_feed(train_np, TRAIN, base_dir,
+                                            seed=seed)
+    test_shapes = test_src = None
+    if test_np is not None:
+        test_shapes, test_src = build_db_feed(test_np, TEST, base_dir,
+                                              seed=seed)
+    return train_shapes, train_src, test_shapes, test_src
 
 
 def _net_base_dir(sp, solver_path):
@@ -92,41 +107,72 @@ def _feed_shapes_arg(specs):
 
 def cmd_train(args):
     from .proto import text_format
-    from .solver.solver import Solver
+    from .solver.solver import Solver, resolve_nets
     from .utils.signals import SignalPolicy
+    from .data.prefetch import PrefetchIterator
 
+    import os
     sp = text_format.load(args.solver, "SolverParameter")
     base_dir = _net_base_dir(sp, args.solver)
-    feed = _feed_shapes_arg(args.input_shape)
+    if sp.has("snapshot_prefix") and base_dir \
+            and not os.path.isabs(sp.snapshot_prefix):
+        # stock prefixes ("examples/cifar10/...") are caffe-root-relative;
+        # anchor them where the net/sources resolved, not the process CWD
+        sp.snapshot_prefix = os.path.join(base_dir, sp.snapshot_prefix)
+    train_np, test_np = resolve_nets(sp, base_dir)
+    seed = int(sp.random_seed) if int(sp.random_seed) >= 0 else None
+    train_shapes, train_src, test_shapes, test_src = _real_feeds(
+        train_np, test_np, base_dir, seed=seed)
+    feed = {**(train_shapes or {}), **_feed_shapes_arg(args.input_shape)}
+
     if args.strategy == "dp":
         from .parallel import DataParallelSolver, make_mesh
         solver = DataParallelSolver(sp, mesh=make_mesh(_mesh_arg(args.mesh))
                                     if args.mesh else None, base_dir=base_dir,
-                                    feed_shapes=feed)
+                                    feed_shapes=feed or None,
+                                    test_feed_shapes=test_shapes)
     else:
-        solver = Solver(sp, base_dir=base_dir, feed_shapes=feed)
+        solver = Solver(sp, base_dir=base_dir, feed_shapes=feed or None,
+                        test_feed_shapes=test_shapes)
     if args.weights:
         solver.load_weights(args.weights)
     if args.snapshot:
         solver.restore(args.snapshot)
     total = args.iterations or int(sp.max_iter) or 1000
-    data_iter = _make_data_iter(solver.net)
-    test_fn = (lambda: _make_data_iter(solver.test_net, seed=1)) \
-        if solver.test_net is not None else None
+    if train_src is not None:
+        print(f"Training from {train_src.source} "
+              f"({len(train_src.db)} records)")
+        data_iter = PrefetchIterator(iter(train_src), depth=3)
+    else:
+        print("WARNING: no Data-layer LMDB source found; "
+              "feeding synthetic noise (shapes only)")
+        data_iter = _make_data_iter(solver.net)
+    if test_src is not None:
+        test_fn = lambda: iter(test_src)  # noqa: E731 — fresh pass per test
+    else:
+        test_fn = (lambda: _make_data_iter(solver.test_net, seed=1)) \
+            if solver.test_net is not None else None
     prefix = args.snapshot_prefix or (
         sp.snapshot_prefix if sp.has("snapshot_prefix") else None)
     policy = SignalPolicy(sigint=args.sigint_effect,
                           sighup=args.sighup_effect)
-    with policy:
-        while solver.iter < total:
-            n = min(100, total - solver.iter)
-            solver.step(n, data_iter, test_data_fn=test_fn)
-            action = policy.pending()
-            if action == "snapshot":
-                solver.snapshot(prefix=prefix or "snap")
-            elif action == "stop":
-                print("stopping early on signal")
-                break
+    try:
+        with policy:
+            while solver.iter < total:
+                n = min(100, total - solver.iter)
+                solver.step(n, data_iter, test_data_fn=test_fn)
+                action = policy.pending()
+                if action == "snapshot":
+                    solver.snapshot(prefix=prefix or "snap")
+                elif action == "stop":
+                    print("stopping early on signal")
+                    break
+    finally:
+        if train_src is not None:
+            data_iter.close()
+            train_src.close()
+        if test_src is not None:
+            test_src.close()
     if prefix and sp.snapshot:
         solver.snapshot(prefix=prefix)
     print(f"Optimization done, iter={solver.iter}")
@@ -134,23 +180,72 @@ def cmd_train(args):
 
 
 def cmd_test(args):
+    import os
     import numpy as np
     from .proto import text_format
-    from .graph.compiler import CompiledNet, TEST
-    from .solver.solver import Solver
+    from .solver.solver import Solver, resolve_nets
     from .proto import Message
+    from .graph.compiler import TEST
+    from .data.db_source import build_db_feed, phase_data_layers
 
     net_param = text_format.load(args.model, "NetParameter")
     sp = Message("SolverParameter", base_lr=0.0, lr_policy="fixed",
                  display=0)
     sp.net_param = net_param
-    solver = Solver(sp, feed_shapes=_feed_shapes_arg(args.input_shape))
+
+    # resolve the TEST Data layer's source relative to the model file,
+    # walking up like _net_base_dir (stock sources are caffe-root-relative)
+    test_shapes = test_src = None
+    layers = phase_data_layers(net_param, TEST)
+    if layers and layers[0].has("data_param"):
+        rel = layers[0].data_param.source
+        d = os.path.dirname(os.path.abspath(args.model))
+        while True:
+            test_shapes, test_src = build_db_feed(net_param, TEST, d)
+            if test_src is not None:
+                break
+            parent = os.path.dirname(d)
+            if parent == d:
+                break
+            d = parent
+    # the (unused) TRAIN net compiles with the test shapes — param shapes
+    # don't depend on batch size, and only the TEST net is stepped here
+    solver = Solver(sp, feed_shapes=_feed_shapes_arg(args.input_shape)
+                    or test_shapes, test_feed_shapes=test_shapes)
     if args.weights:
         solver.load_weights(args.weights)
-    it = _make_data_iter(solver.test_net or solver.net)
+    if test_src is not None:
+        print(f"Scoring on {test_src.source} ({len(test_src.db)} records)")
+        it = iter(test_src)
+    else:
+        print("WARNING: no Data-layer LMDB source found; synthetic batches")
+        it = _make_data_iter(solver.test_net or solver.net)
     scores = solver.test(it, num_iters=args.iterations)
     for k, v in scores.items():
         print(f"{k} = {np.asarray(v).mean():.6f}")
+    if test_src is not None:
+        test_src.close()
+    return 0
+
+
+def cmd_convert_cifar(args):
+    from . import tools
+    tools.convert_cifar_data(args.input, args.output)
+    return 0
+
+
+def cmd_compute_mean(args):
+    from . import tools
+    tools.compute_image_mean(args.db, args.output)
+    return 0
+
+
+def cmd_convert_imageset(args):
+    from . import tools
+    tools.convert_imageset(args.root, args.listfile, args.db,
+                           resize_height=args.resize_height,
+                           resize_width=args.resize_width, gray=args.gray,
+                           shuffle=args.shuffle, encoded=args.encoded)
     return 0
 
 
@@ -219,7 +314,8 @@ def cmd_cifar(args):
     from .apps import CifarApp
     app = CifarApp(num_workers=args.workers, data_dir=args.data,
                    prototxt_dir=args.prototxt_dir, strategy=args.strategy,
-                   tau=args.tau, log_path=args.log)
+                   tau=args.tau, log_path=args.log,
+                   metrics_path=args.metrics)
     app.run(num_rounds=args.rounds)
     return 0
 
@@ -228,7 +324,7 @@ def cmd_imagenet(args):
     from .apps import ImageNetApp
     app = ImageNetApp(num_workers=args.workers, strategy=args.strategy,
                       tau=args.tau, batch=args.batch, log_path=args.log,
-                      num_classes=args.classes)
+                      num_classes=args.classes, metrics_path=args.metrics)
     app.run(num_rounds=args.rounds)
     return 0
 
@@ -273,6 +369,30 @@ def main(argv=None):
     d = sub.add_parser("device_query", help="list devices")
     d.set_defaults(fn=cmd_device_query)
 
+    cc = sub.add_parser("convert_cifar_data",
+                        help="CIFAR-10 .bin batches -> train/test LMDBs")
+    cc.add_argument("input", help="dir with data_batch_*.bin + test_batch.bin")
+    cc.add_argument("output", help="dir to create cifar10_{train,test}_lmdb")
+    cc.set_defaults(fn=cmd_convert_cifar)
+
+    cm = sub.add_parser("compute_image_mean",
+                        help="Datum DB -> mean image .binaryproto")
+    cm.add_argument("db")
+    cm.add_argument("output")
+    cm.set_defaults(fn=cmd_compute_mean)
+
+    ci = sub.add_parser("convert_imageset",
+                        help='images + "path label" listfile -> Datum LMDB')
+    ci.add_argument("root", help="root folder of image paths")
+    ci.add_argument("listfile")
+    ci.add_argument("db")
+    ci.add_argument("--resize_height", type=int, default=0)
+    ci.add_argument("--resize_width", type=int, default=0)
+    ci.add_argument("--gray", action="store_true")
+    ci.add_argument("--shuffle", action="store_true")
+    ci.add_argument("--encoded", action="store_true")
+    ci.set_defaults(fn=cmd_convert_imageset)
+
     c = sub.add_parser("cifar", help="CifarApp driver")
     c.add_argument("--workers", type=int, default=None)
     c.add_argument("--data", help="dir with CIFAR-10 .bin batches")
@@ -282,6 +402,7 @@ def main(argv=None):
     c.add_argument("--tau", type=int, default=10)
     c.add_argument("--rounds", type=int, default=20)
     c.add_argument("--log")
+    c.add_argument("--metrics", help="JSONL metrics output path")
     c.set_defaults(fn=cmd_cifar)
 
     i = sub.add_parser("imagenet", help="ImageNetApp driver")
@@ -293,9 +414,16 @@ def main(argv=None):
     i.add_argument("--classes", type=int, default=1000)
     i.add_argument("--rounds", type=int, default=2)
     i.add_argument("--log")
+    i.add_argument("--metrics", help="JSONL metrics output path")
     i.set_defaults(fn=cmd_imagenet)
 
     args = p.parse_args(argv)
+    if args.verb in ("train", "test", "time", "device_query", "cifar",
+                     "imagenet"):
+        # multi-host bootstrap (no-op single-process; SPARKNET_COORDINATOR
+        # et al. select the jax.distributed rendezvous — see DEPLOY.md)
+        from .parallel import distributed_init
+        distributed_init()
     return args.fn(args)
 
 

@@ -4,6 +4,15 @@ path with loader-push into device memory)."""
 from .cifar import CifarDataset, read_batch_file, write_batch_file
 from .sampler import MinibatchSampler
 from .synthetic import class_gaussian_images, batch_stream
+from .lmdb import LMDBReader, LMDBWriter
+from .datum import array_to_datum, datum_to_array, encoded_datum
+from .db_source import DatumBatchSource, build_db_feed, open_db
+from .transforms import (DataTransformer, load_mean_binaryproto,
+                         save_mean_binaryproto)
 
 __all__ = ["CifarDataset", "read_batch_file", "write_batch_file",
-           "MinibatchSampler", "class_gaussian_images", "batch_stream"]
+           "MinibatchSampler", "class_gaussian_images", "batch_stream",
+           "LMDBReader", "LMDBWriter", "array_to_datum", "datum_to_array",
+           "encoded_datum", "DatumBatchSource", "build_db_feed", "open_db",
+           "DataTransformer", "load_mean_binaryproto",
+           "save_mean_binaryproto"]

@@ -1,0 +1,141 @@
+"""DB-backed Datum batch sources — the host side of the reference DataLayer.
+
+The reference's Data layer owns a DB cursor that walks records sequentially
+and wraps at the end (data_layer.cpp:14-60, db_lmdb.cpp LMDBCursor), with
+``rand_skip`` advancing the cursor once at startup and a DataTransformer
+applying crop/mirror/scale/mean per record. On TPU the graph is pure, so
+this runs host-side: a `DatumBatchSource` yields ready feed dicts that the
+training loop (or a PrefetchIterator wrapping it) device_puts into the
+compiled step.
+"""
+
+import os
+
+import numpy as np
+
+from .lmdb import LMDBReader
+from .datum import datum_to_array
+from .transforms import DataTransformer
+
+
+def open_db(source, backend="lmdb"):
+    """DataParameter.DB -> reader. The reference supports LEVELDB and LMDB
+    (db.hpp GetDB); here LMDB is native and LevelDB is unsupported (its
+    snappy-compressed SSTables need a native dependency this environment
+    deliberately avoids) — convert with `sparknet convert_imageset`."""
+    if isinstance(backend, int):
+        backend = {0: "leveldb", 1: "lmdb"}[backend]
+    backend = backend.lower()
+    if backend == "lmdb":
+        return LMDBReader(source)
+    raise NotImplementedError(
+        f"backend {backend!r}: only LMDB databases are readable "
+        "(re-create LevelDB sources with `sparknet convert_imageset`)")
+
+
+class DatumBatchSource:
+    """Infinite batched iterator over a Datum database.
+
+    Yields {data_top: float32 (B,C,ch,cw), label_top: int32 (B,)} feed
+    dicts. Sequential wrap-around read order matches the reference cursor
+    (data_layer.cpp:40-45: "restarting data prefetching from start").
+    """
+
+    def __init__(self, source, batch_size, phase=0, transform_param=None,
+                 backend="lmdb", rand_skip=0, base_dir="", seed=None,
+                 data_top="data", label_top="label"):
+        self.source = source
+        self.batch_size = int(batch_size)
+        self.data_top, self.label_top = data_top, label_top
+        rng = np.random.RandomState(seed)
+        self.transformer = DataTransformer(transform_param, phase=phase,
+                                           base_dir=base_dir, rng=rng)
+        self.db = open_db(source, backend)
+        if len(self.db) == 0:
+            raise ValueError(f"{source}: empty database")
+        # rand_skip: advance the cursor once by rand() % rand_skip
+        # (data_layer.cpp DataLayerSetUp)
+        self._skip = int(rng.randint(0, rand_skip)) if rand_skip else 0
+        first = next(self.db.items())[1]
+        arr, _ = datum_to_array(first)
+        self.record_shape = arr.shape if arr.ndim == 3 \
+            else (1, 1, int(arr.size))
+        self.shape = (self.batch_size,) + \
+            self.transformer.output_shape(self.record_shape)
+
+    @property
+    def num_batches(self):
+        """Batches per full pass (ragged tail wraps, as in the reference)."""
+        return max(1, len(self.db) // self.batch_size)
+
+    def _records(self):
+        skip = self._skip
+        self._skip = 0
+        while True:
+            for _, value in self.db.items():
+                if skip:
+                    skip -= 1
+                    continue
+                yield datum_to_array(value)
+
+    def __iter__(self):
+        rec = self._records()
+        c, h, w = self.record_shape
+        while True:
+            arrs = []
+            labels = np.empty(self.batch_size, np.int32)
+            for i in range(self.batch_size):
+                arr, labels[i] = next(rec)
+                arrs.append(arr.reshape(c, h, w))
+            batch = np.stack(arrs)  # uint8, or float32 for float_data nets
+            yield {self.data_top: self.transformer(batch),
+                   self.label_top: labels}
+
+    def close(self):
+        self.db.close()
+
+
+def phase_data_layers(net_param, phase):
+    """Data-source layers of `net_param` active in `phase` (after the same
+    include/exclude filtering FilterNet applies, net.cpp:287)."""
+    from ..graph.compiler import filter_net
+    out = []
+    for lp in filter_net(net_param, phase).layer:
+        if lp.type in ("Data", "ImageData"):
+            out.append(lp)
+    return out
+
+
+def build_db_feed(net_param, phase, base_dir="", seed=None):
+    """If the net's phase-filtered Data layer points at an existing LMDB,
+    return (feed_shapes, source); else (None, None) — the caller falls back
+    to synthetic feeds. This is what lets `sparknet train --solver
+    cifar10_full_solver.prototxt` run the reference's most basic flow:
+    stock prototxt -> real records -> trained net."""
+    for lp in phase_data_layers(net_param, phase):
+        if lp.type != "Data" or not lp.has("data_param"):
+            continue
+        dp = lp.data_param
+        source = dp.source
+        if base_dir and not os.path.isabs(source):
+            source = os.path.join(base_dir, source)
+        if not os.path.exists(_db_file(source)):
+            continue
+        tops = list(lp.top)
+        src = DatumBatchSource(
+            source, int(dp.batch_size), phase=phase,
+            transform_param=lp.transform_param
+            if lp.has("transform_param") else None,
+            backend=int(dp.backend) if dp.has("backend") else "lmdb",
+            rand_skip=int(dp.rand_skip), base_dir=base_dir, seed=seed,
+            data_top=tops[0], label_top=tops[1] if len(tops) > 1 else "label")
+        shapes = {tops[0]: src.shape}
+        if len(tops) > 1:
+            shapes[tops[1]] = (src.batch_size,)
+        return shapes, src
+    return None, None
+
+
+def _db_file(source):
+    return os.path.join(source, "data.mdb") if not source.endswith(".mdb") \
+        else source

@@ -30,6 +30,7 @@ class PrefetchIterator:
         self._q = queue.Queue(maxsize=depth)
         self._transform = transform
         self._stop = threading.Event()
+        self._done = False
         self._error = None
         self._source = iter(source)
         self._src_lock = threading.Lock()
@@ -70,17 +71,25 @@ class PrefetchIterator:
         return self
 
     def __next__(self):
-        while True:
-            item = self._q.get()
-            if item is _END:
-                if self._error is not None:
-                    raise self._error
-                raise StopIteration
-            return item
+        if self._done:
+            # exhausted or closed: re-raise the worker error (if any)
+            # instead of blocking forever on an empty queue
+            if self._error is not None:
+                raise self._error
+            raise StopIteration
+        item = self._q.get()
+        if item is _END:
+            self._done = True
+            if self._error is not None:
+                raise self._error
+            raise StopIteration
+        return item
 
     def close(self):
+        self._done = True
         self._stop.set()
-        # drain so producers blocked on put() can exit
+        # drain so producers blocked on put() can exit; a worker error that
+        # already surfaced stays in self._error for subsequent __next__
         try:
             while True:
                 self._q.get_nowait()

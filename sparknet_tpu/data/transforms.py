@@ -92,6 +92,113 @@ def subtract_mean(images, mean_image):
     return images - mean
 
 
+def load_mean_binaryproto(path):
+    """.binaryproto BlobProto -> (C,H,W) float32 mean image
+    (data_transformer.cpp:19-28 mean_file load)."""
+    from ..proto import wire
+    blob = wire.load(path, "BlobProto")
+    data = np.asarray(blob.data, np.float32)
+    if blob.has("shape"):
+        shape = tuple(int(d) for d in blob.shape.dim)
+    else:
+        shape = (int(blob.num), int(blob.channels), int(blob.height),
+                 int(blob.width))
+    data = data.reshape([d for d in shape if d] or [-1])
+    if data.ndim == 4:       # legacy num=1 leading axis
+        data = data[0]
+    return data
+
+
+def save_mean_binaryproto(mean, path):
+    """(C,H,W) float32 -> .binaryproto BlobProto with legacy NCHW dims
+    (what tools/compute_image_mean.cpp writes)."""
+    from ..proto import Message, wire
+    mean = np.asarray(mean, np.float32)
+    c, h, w = mean.shape
+    blob = Message("BlobProto", num=1, channels=c, height=h, width=w)
+    blob.data.extend_np(mean.ravel())
+    wire.dump(blob, path)
+
+
+class DataTransformer:
+    """TransformationParameter-driven batch transform — the configuration
+    surface of the reference DataTransformer (data_transformer.cpp:19-51):
+    scale, mirror, crop_size, mean_file XOR mean_value, with TRAIN = random
+    crop + random mirror and TEST = center crop + random mirror (caffe
+    mirrors in both phases when mirror:true)."""
+
+    def __init__(self, tp=None, phase=0, base_dir="", rng=None):
+        import os
+        self.phase = phase
+        self.rng = rng or np.random.RandomState()
+        self.scale = float(tp.scale) if tp is not None else 1.0
+        self.mirror = bool(tp.mirror) if tp is not None else False
+        self.crop_size = int(tp.crop_size) if tp is not None else 0
+        self.mean = None
+        self.full_mean = False
+        if tp is not None and tp.has("mean_file"):
+            if list(tp.mean_value):
+                raise ValueError(
+                    "specify either mean_file or mean_value, not both "
+                    "(data_transformer.cpp CHECK)")
+            path = tp.mean_file
+            if base_dir and not os.path.isabs(path):
+                path = os.path.join(base_dir, path)
+            self.mean = load_mean_binaryproto(path)
+            self.full_mean = True
+        elif tp is not None and list(tp.mean_value):
+            self.mean = np.asarray([float(v) for v in tp.mean_value],
+                                   np.float32)
+
+    def output_shape(self, record_shape):
+        c, h, w = record_shape
+        s = self.crop_size or None
+        return (c, s or h, s or w)
+
+    def __call__(self, images):
+        """uint8/float (N,C,H,W) -> float32 (N,C,crop,crop)."""
+        images = np.asarray(images)
+        n, c, h, w = images.shape
+        crop = self.crop_size or h
+        if self.crop_size:
+            if self.phase == 0:  # TRAIN: random offsets
+                ys = self.rng.randint(0, h - crop + 1, n).astype(np.int32)
+                xs = self.rng.randint(0, w - crop + 1, n).astype(np.int32)
+            else:                # TEST: center
+                ys = np.full(n, (h - crop) // 2, np.int32)
+                xs = np.full(n, (w - crop) // 2, np.int32)
+        else:
+            ys = xs = None
+        flips = self.rng.randint(0, 2, n).astype(np.uint8) \
+            if self.mirror else None
+        mean = self.mean
+        if mean is not None and mean.ndim == 1 and len(mean) not in (1, c):
+            raise ValueError(
+                f"mean_value count {len(mean)} != channels {c}")
+        if mean is not None and mean.ndim == 1 and len(mean) == 1:
+            mean = np.repeat(mean, c)
+        if images.dtype == np.uint8:
+            return native.transform_batch(
+                images, crop, ys=ys, xs=xs, mirror=flips, mean=mean,
+                scale=self.scale, full_mean=self.full_mean)
+        # float records (float_data datums): numpy path
+        out = np.empty((n, c, crop, crop), np.float32)
+        for i in range(n):
+            y0 = int(ys[i]) if ys is not None else 0
+            x0 = int(xs[i]) if xs is not None else 0
+            win = images[i, :, y0:y0 + crop, x0:x0 + crop].astype(np.float32)
+            if mean is not None and self.full_mean:
+                win = win - mean[:, y0:y0 + crop, x0:x0 + crop]
+            if flips is not None and flips[i]:
+                win = win[:, :, ::-1]
+            out[i] = win
+        if mean is not None and not self.full_mean:
+            out -= mean.reshape(1, -1, 1, 1)
+        if self.scale != 1.0:
+            out *= self.scale
+        return out
+
+
 def compute_mean(image_iter, shape):
     """Streaming mean image over an iterator of (N, C, H, W) uint8 arrays —
     the ComputeMean.scala:10-37 accumulator without the RDD."""

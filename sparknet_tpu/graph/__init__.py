@@ -1,7 +1,9 @@
 """Graph compiler: NetParameter -> pure init/apply (replaces caffe::Net)."""
 
 from .compiler import CompiledNet, filter_net, upgrade_v1, TRAIN, TEST
+from .upgrade import upgrade_net, upgrade_v0, needs_v0_upgrade
 from .registry import register, get, Layer
 
-__all__ = ["CompiledNet", "filter_net", "upgrade_v1", "TRAIN", "TEST",
+__all__ = ["CompiledNet", "filter_net", "upgrade_v1", "upgrade_net",
+           "upgrade_v0", "needs_v0_upgrade", "TRAIN", "TEST",
            "register", "get", "Layer"]

@@ -135,7 +135,8 @@ class CompiledNet:
 
     def __init__(self, net_param, phase=TRAIN, feed_shapes=None,
                  dtype=jnp.float32, level=0, stages=()):
-        net_param = upgrade_v1(net_param)
+        from .upgrade import upgrade_net
+        net_param = upgrade_net(net_param)
         self.phase = phase
         self.dtype = dtype
         self.net_param = filter_net(net_param, phase, level, stages)
@@ -341,7 +342,8 @@ class CompiledNet:
         """Copy weights from a NetParameter by layer name (reference
         net.cpp CopyTrainedLayersFrom :805): shapes must match; layers
         absent from either side are skipped unless strict."""
-        net_proto = upgrade_v1(net_proto)
+        from .upgrade import upgrade_net
+        net_proto = upgrade_net(net_proto)
         by_name = {l.name: l for l in net_proto.layer}
         params = {k: list(v) for k, v in params.items()}
         state = {k: list(v) for k, v in (state or {}).items()}
@@ -378,8 +380,11 @@ def blob_to_array(bp):
     else:
         shape = [d for d in (bp.num, bp.channels, bp.height, bp.width)]
         # legacy 4D: strip leading 1s only if count matches without them
-    data = bp.double_data if bp.double_data else bp.data
-    arr = np.asarray(list(data), np.float32)
+    data = bp.double_data if len(bp.double_data) else bp.data
+    # no intermediate list(): the wire codec hands packed floats back as a
+    # numpy array, and RepeatedField is already list-like — a 230MB
+    # CaffeNet import must not pay a per-element Python copy here
+    arr = np.asarray(data, np.float32)
     if shape and int(np.prod(shape)) == arr.size:
         arr = arr.reshape(shape)
     return arr
@@ -388,5 +393,5 @@ def blob_to_array(bp):
 def array_to_blob(arr):
     bp = Message("BlobProto")
     bp.ensure("shape").dim.extend(int(d) for d in arr.shape)
-    bp.data.extend_raw(np.asarray(arr, np.float32).ravel().tolist())
+    bp.data.extend_np(np.asarray(arr, np.float32).ravel())
     return bp

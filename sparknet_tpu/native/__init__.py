@@ -25,13 +25,22 @@ _tried = False
 
 
 def _build():
+    # Compile to a per-pid temp file and rename atomically: concurrent
+    # builders (pytest workers, multi-host on a shared FS) must never dlopen
+    # a partially written .so, and rename() makes the publish atomic.
+    tmp = f"{_SO}.build.{os.getpid()}"
     base = ["g++", "-O3", "-march=native", "-shared", "-fPIC", "-std=c++17"]
     try:
-        subprocess.run(base + ["-fopenmp", _SRC, "-o", _SO], check=True,
-                       capture_output=True)
-    except subprocess.CalledProcessError:   # no libgomp: single-threaded
-        subprocess.run(base + [_SRC, "-o", _SO], check=True,
-                       capture_output=True)
+        try:
+            subprocess.run(base + ["-fopenmp", _SRC, "-o", tmp], check=True,
+                           capture_output=True)
+        except subprocess.CalledProcessError:   # no libgomp: single-threaded
+            subprocess.run(base + [_SRC, "-o", tmp], check=True,
+                           capture_output=True)
+        os.replace(tmp, _SO)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
 
 
 def _load():
@@ -79,10 +88,14 @@ def _ptr(arr, ctype):
 
 
 def transform_batch(images, crop, ys=None, xs=None, mirror=None, mean=None,
-                    scale=1.0):
+                    scale=1.0, full_mean=False):
     """uint8 (N,C,H,W) -> float32 (N,C,crop,crop); native when possible.
 
-    mean: None | (C,) per-channel | (C,crop,crop) cropped mean image.
+    mean: None | (C,) per-channel | (C,crop,crop) cropped mean image
+    (subtracted after the mirror) | with full_mean=True a (C,H,W)
+    source-size mean image subtracted at the crop-window source index
+    before the mirror — the exact reference mean_file semantics
+    (data_transformer.cpp:42-51).
     ys/xs: per-image int32 crop offsets (None -> 0: top-left/no crop).
     mirror: per-image uint8 flags (None -> no flips).
     """
@@ -91,9 +104,18 @@ def transform_batch(images, crop, ys=None, xs=None, mirror=None, mean=None,
     n, c, h, w = images.shape
     if mean is not None:
         mean = np.ascontiguousarray(mean, np.float32)
-        mean_kind = 1 if mean.ndim == 1 else 2
-        if mean.ndim == 3 and mean.shape != (c, crop, crop):
-            raise ValueError(f"mean shape {mean.shape} != {(c, crop, crop)}")
+        if mean.ndim == 1:
+            mean_kind = 1
+        elif full_mean:
+            mean_kind = 3
+            if mean.shape != (c, h, w):
+                raise ValueError(
+                    f"full mean shape {mean.shape} != {(c, h, w)}")
+        else:
+            mean_kind = 2
+            if mean.shape != (c, crop, crop):
+                raise ValueError(
+                    f"mean shape {mean.shape} != {(c, crop, crop)}")
     else:
         mean_kind = 0
     if lib is not None:
@@ -116,6 +138,8 @@ def transform_batch(images, crop, ys=None, xs=None, mirror=None, mean=None,
         y0 = int(ys[i]) if ys is not None else 0
         x0 = int(xs[i]) if xs is not None else 0
         win = images[i, :, y0:y0 + crop, x0:x0 + crop].astype(np.float32)
+        if mean_kind == 3:  # source-indexed subtract, then mirror
+            win = win - mean[:, y0:y0 + crop, x0:x0 + crop]
         if mirror is not None and mirror[i]:
             win = win[:, :, ::-1]
         out[i] = win

@@ -22,8 +22,12 @@ extern "C" {
 
 // (n,c,h,w) uint8 -> (n,c,crop,crop) float32: per-image crop offsets
 // (ys/xs), optional horizontal mirror, mean subtraction, scale.
-// mean: nullptr | per-channel (mean_kind=1, c floats) | full CHW image at
-// the CROPPED size (mean_kind=2, c*crop*crop floats).
+// mean: nullptr | per-channel (mean_kind=1, c floats) | CHW image at
+// the CROPPED size (mean_kind=2, c*crop*crop floats, subtracted after the
+// mirror) | CHW image at the SOURCE size (mean_kind=3, c*h*w floats,
+// subtracted at the source crop-window index before the mirror — the exact
+// mean_file semantics of the reference data_transformer.cpp:42-51, where
+// top[mirrored_index] = (src[data_index] - mean[data_index]) * scale).
 void transform_batch(const uint8_t* in, int64_t n, int64_t c, int64_t h,
                      int64_t w, int64_t crop, const int32_t* ys,
                      const int32_t* xs, const uint8_t* mirror,
@@ -45,12 +49,24 @@ void transform_batch(const uint8_t* in, int64_t n, int64_t c, int64_t h,
       float* dplane = dst + ch * crop * crop;
       const float* mplane =
           mean_kind == 2 ? mean + ch * crop * crop : nullptr;
+      const float* fplane =
+          mean_kind == 3 ? mean + ch * h * w : nullptr;
       const float mchan = mean_kind == 1 ? mean[ch] : 0.0f;
       for (int64_t y = 0; y < crop; ++y) {
         const uint8_t* __restrict srow = splane + (y0 + y) * w + x0;
         float* __restrict drow = dplane + y * crop;
         // branch-free inner loops so gcc vectorizes the u8->f32 convert
-        if (!flip && mplane) {
+        if (fplane) {  // full-size mean, source-indexed (pre-mirror)
+          const float* __restrict mrow = fplane + (y0 + y) * w + x0;
+          if (!flip) {
+            for (int64_t x = 0; x < crop; ++x)
+              drow[x] = ((float)srow[x] - mrow[x]) * scale;
+          } else {
+            for (int64_t x = 0; x < crop; ++x)
+              drow[x] = ((float)srow[crop - 1 - x] - mrow[crop - 1 - x])
+                        * scale;
+          }
+        } else if (!flip && mplane) {
           const float* __restrict mrow = mplane + y * crop;
           for (int64_t x = 0; x < crop; ++x)
             drow[x] = ((float)srow[x] - mrow[x]) * scale;
@@ -91,6 +107,6 @@ void accumulate_sum(const uint8_t* in, int64_t n, int64_t chw,
   }
 }
 
-int native_abi_version() { return 1; }
+int native_abi_version() { return 2; }
 
 }  // extern "C"

@@ -1,4 +1,4 @@
-"""Pallas flash-attention kernel for TPU.
+"""Pallas flash-attention kernel for TPU — forward AND blockwise backward.
 
 The per-chip complement to parallel.ring: ring attention distributes the
 sequence across chips; THIS kernel computes each chip's local attention
@@ -6,12 +6,22 @@ without ever materializing the (S, S) score matrix — the flash recurrence
 (running max m, denominator l, unnormalized accumulator acc) over K/V
 blocks streamed through VMEM, with the MXU doing the two matmuls per block.
 K/V arrive in (block_k, D) tiles via a third, sequential grid dimension, so
-VMEM usage is O(block) regardless of S (verified to S=32k on one v5e chip).
+VMEM usage is O(block) regardless of S.
 
-Forward is a pallas kernel; backward recomputes through the dense path
-(jax.custom_vjp) — fine at training block sizes, while the kernel shines
-for long-context inference/eval. Interpret mode (CPU tests) engages
-automatically off-TPU.
+Training memory is O(block) too: the forward additionally emits the
+per-row logsumexp (LSE, lane-replicated like jax's own TPU kernel), and
+the backward re-derives each probability block as P = exp(S - LSE) inside
+two pallas kernels — dQ with K/V streamed innermost, dK/dV with Q/dO
+streamed innermost (the FlashAttention-2 recurrences):
+
+    delta_i = rowsum(dO_i * O_i)                (recomputed per block visit)
+    P_ij    = exp(scale * Q_i K_j^T - LSE_i)
+    dV_j   += P_ij^T dO_i
+    dS_ij   = P_ij * (dO_i V_j^T - delta_i)
+    dQ_i   += scale * dS_ij K_j
+    dK_j   += scale * dS_ij^T Q_i
+
+Interpret mode (CPU tests) engages automatically off-TPU.
 """
 
 import functools
@@ -21,13 +31,12 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from ..parallel.ring import dense_attention
-
 NEG_INF = -1e30
+LANES = 128
 
 
-def _fa_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
-               causal, scale):
+def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
+               *, causal, scale):
     _, bq, d = q_ref.shape
     bk = k_ref.shape[1]
     qi = pl.program_id(1)
@@ -71,6 +80,7 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
     def _finish():
         l = jnp.maximum(l_ref[:, :1], 1e-30)
         o_ref[0] = (acc_ref[:] / l).astype(o_ref.dtype)
+        lse_ref[0] = m_ref[:] + jnp.log(jnp.maximum(l_ref[:], 1e-30))
 
 
 def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret):
@@ -84,7 +94,7 @@ def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret):
     kf = k.reshape(b * h, s, d)
     vf = v.reshape(b * h, s, d)
     kernel = functools.partial(_fa_kernel, causal=causal, scale=scale)
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=(b * h, s // block_q, s // block_k),
         in_specs=[
@@ -92,16 +102,161 @@ def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret):
             pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, j, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0)),
+            pl.BlockSpec((1, block_q, LANES),
+                         lambda bh, i, j: (bh, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, s, LANES), jnp.float32),
+        ],
         scratch_shapes=[
-            pltpu.VMEM((block_q, d), jnp.float32),     # acc
-            pltpu.VMEM((block_q, 128), jnp.float32),   # m (lane-replicated)
-            pltpu.VMEM((block_q, 128), jnp.float32),   # l
+            pltpu.VMEM((block_q, d), jnp.float32),       # acc
+            pltpu.VMEM((block_q, LANES), jnp.float32),   # m (lane-replicated)
+            pltpu.VMEM((block_q, LANES), jnp.float32),   # l
         ],
         interpret=interpret,
     )(qf, kf, vf)
-    return out.reshape(b, h, s, d)
+    return out.reshape(b, h, s, d), lse
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dq_ref,
+               acc_ref, *, causal, scale):
+    _, bq, d = q_ref.shape
+    bk = k_ref.shape[1]
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    live = (ki * bk <= qi * bq + bq - 1) if causal else True
+
+    @pl.when(live)
+    def _step():
+        qb = q_ref[0].astype(jnp.float32)
+        kb = k_ref[0].astype(jnp.float32)
+        vb = v_ref[0].astype(jnp.float32)
+        dob = do_ref[0].astype(jnp.float32)
+        ob = o_ref[0].astype(jnp.float32)
+        delta = jnp.sum(dob * ob, axis=1, keepdims=True)        # (bq, 1)
+        sc = scale * jax.lax.dot_general(qb, kb, (((1,), (1,)), ((), ())),
+                                         preferred_element_type=jnp.float32)
+        if causal:
+            q_pos = qi * bq + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, bk), 0)
+            k_pos = ki * bk + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, bk), 1)
+            sc = jnp.where(q_pos >= k_pos, sc, NEG_INF)
+        p = jnp.exp(sc - lse_ref[0][:, :1])                     # (bq, bk)
+        dp = jax.lax.dot_general(dob, vb, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        acc_ref[:] += scale * jax.lax.dot_general(
+            ds, kb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(ki == nk - 1)
+    def _finish():
+        dq_ref[0] = acc_ref[:].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dk_ref, dv_ref,
+                dk_acc, dv_acc, *, causal, scale):
+    _, bq, d = q_ref.shape
+    bk = k_ref.shape[1]
+    ki = pl.program_id(1)       # note: grid is (bh, j, i) here
+    qi = pl.program_id(2)
+    nq = pl.num_programs(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    live = (ki * bk <= qi * bq + bq - 1) if causal else True
+
+    @pl.when(live)
+    def _step():
+        qb = q_ref[0].astype(jnp.float32)
+        kb = k_ref[0].astype(jnp.float32)
+        vb = v_ref[0].astype(jnp.float32)
+        dob = do_ref[0].astype(jnp.float32)
+        ob = o_ref[0].astype(jnp.float32)
+        delta = jnp.sum(dob * ob, axis=1, keepdims=True)
+        sc = scale * jax.lax.dot_general(qb, kb, (((1,), (1,)), ((), ())),
+                                         preferred_element_type=jnp.float32)
+        if causal:
+            q_pos = qi * bq + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, bk), 0)
+            k_pos = ki * bk + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, bk), 1)
+            sc = jnp.where(q_pos >= k_pos, sc, NEG_INF)
+        p = jnp.exp(sc - lse_ref[0][:, :1])
+        # dV_j += P^T dO
+        dv_acc[:] += jax.lax.dot_general(
+            p, dob, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(dob, vb, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        # dK_j += scale * dS^T Q
+        dk_acc[:] += scale * jax.lax.dot_general(
+            ds, qb, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(qi == nq - 1)
+    def _finish():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _flash_backward(q, k, v, o, lse, g, causal, scale, block_q, block_k,
+                    interpret):
+    b, h, s, d = q.shape
+    block_q = min(block_q, s)
+    block_k = min(block_k, s)
+    qf = q.reshape(b * h, s, d)
+    kf = k.reshape(b * h, s, d)
+    vf = v.reshape(b * h, s, d)
+    dof = g.reshape(b * h, s, d)
+    of = o.reshape(b * h, s, d)
+
+    q_spec = pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0))
+    k_spec = pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, j, 0))
+    lse_spec = pl.BlockSpec((1, block_q, LANES),
+                            lambda bh, i, j: (bh, i, 0))
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, causal=causal, scale=scale),
+        grid=(b * h, s // block_q, s // block_k),   # K/V innermost
+        in_specs=[q_spec, k_spec, k_spec, q_spec, q_spec, lse_spec],
+        out_specs=q_spec,
+        out_shape=jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(qf, kf, vf, dof, of, lse)
+
+    # second kernel iterates (bh, j, i): Q/dO stream innermost
+    qT_spec = pl.BlockSpec((1, block_q, d), lambda bh, j, i: (bh, i, 0))
+    kT_spec = pl.BlockSpec((1, block_k, d), lambda bh, j, i: (bh, j, 0))
+    lseT_spec = pl.BlockSpec((1, block_q, LANES),
+                             lambda bh, j, i: (bh, i, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, causal=causal, scale=scale),
+        grid=(b * h, s // block_k, s // block_q),
+        in_specs=[qT_spec, kT_spec, kT_spec, qT_spec, qT_spec, lseT_spec],
+        out_specs=[kT_spec, kT_spec],
+        out_shape=[jax.ShapeDtypeStruct((b * h, s, d), k.dtype),
+                   jax.ShapeDtypeStruct((b * h, s, d), v.dtype)],
+        scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
+                        pltpu.VMEM((block_k, d), jnp.float32)],
+        interpret=interpret,
+    )(qf, kf, vf, dof, of, lse)
+    return (dq.reshape(b, h, s, d), dk.reshape(b, h, s, d),
+            dv.reshape(b, h, s, d))
 
 
 def _should_interpret():
@@ -111,24 +266,26 @@ def _should_interpret():
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def flash_attention(q, k, v, causal=False, scale=None, block_q=128,
                     block_k=128):
-    """Flash attention (B, H, S, D) -> (B, H, S, D); exact, O(block) VMEM.
-    scale defaults to 1/sqrt(D)."""
+    """Flash attention (B, H, S, D) -> (B, H, S, D); exact, O(block) VMEM
+    in both forward and backward. scale defaults to 1/sqrt(D)."""
     scale = scale if scale is not None else 1.0 / (q.shape[-1] ** 0.5)
-    return _flash_forward(q, k, v, causal, scale, block_q, block_k,
-                          _should_interpret())
+    out, _ = _flash_forward(q, k, v, causal, scale, block_q, block_k,
+                            _should_interpret())
+    return out
 
 
 def _fwd(q, k, v, causal, scale, block_q, block_k):
-    return flash_attention(q, k, v, causal, scale, block_q, block_k), \
-        (q, k, v)
+    scale = scale if scale is not None else 1.0 / (q.shape[-1] ** 0.5)
+    out, lse = _flash_forward(q, k, v, causal, scale, block_q, block_k,
+                              _should_interpret())
+    return out, (q, k, v, out, lse)
 
 
 def _bwd(causal, scale, block_q, block_k, res, g):
-    q, k, v = res
-    _, vjp = jax.vjp(
-        lambda q, k, v: dense_attention(q, k, v, causal=causal, scale=scale),
-        q, k, v)
-    return vjp(g)
+    q, k, v, o, lse = res
+    scale = scale if scale is not None else 1.0 / (q.shape[-1] ** 0.5)
+    return _flash_backward(q, k, v, o, lse, g, causal, scale, block_q,
+                           block_k, _should_interpret())
 
 
 flash_attention.defvjp(_fwd, _bwd)

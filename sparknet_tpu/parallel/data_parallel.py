@@ -28,15 +28,27 @@ from . import context
 
 
 def shard_batch(batch, mesh, axis=DATA_AXIS, batch_dim=0):
-    """Place a host-global batch dict onto the mesh, sharded along the batch
-    dimension — the analog of an RDD partition landing on its executor."""
+    """Place a batch dict onto the mesh, sharded along the batch dimension —
+    the analog of an RDD partition landing on its executor.
+
+    Single-process: ``batch`` is the global batch; device_put scatters it.
+    Multi-process (jax.process_count() > 1): each host passes only ITS slice
+    of the global batch (see mesh.local_batch_slice — the per-worker RDD
+    partition of CifarApp.scala:56-64) and the global array is assembled
+    from the per-host shards without any host ever holding the full batch.
+    """
     spec = [None] * (batch_dim + 1)
     spec[batch_dim] = axis
+    multihost = jax.process_count() > 1
     out = {}
     for k, v in batch.items():
         v = np.asarray(v)
         s = P(*spec[:v.ndim]) if v.ndim else P()
-        out[k] = jax.device_put(v, NamedSharding(mesh, s))
+        sharding = NamedSharding(mesh, s)
+        if multihost and v.ndim:
+            out[k] = jax.make_array_from_process_local_data(sharding, v)
+        else:
+            out[k] = jax.device_put(v, sharding)
     return out
 
 

@@ -67,6 +67,9 @@ def distributed_init(coordinator_address=None, num_processes=None,
         process_id = int(pid) if pid is not None else None
     if coordinator_address is None and num_processes is None:
         return False  # single-process
+    state = getattr(jax.distributed, "global_state", None)
+    if state is not None and getattr(state, "client", None) is not None:
+        return True   # already initialized (CLI + app both call this)
     jax.distributed.initialize(coordinator_address=coordinator_address,
                                num_processes=num_processes,
                                process_id=process_id)

@@ -10,30 +10,142 @@ relies on, reference pooling_layer.cpp:21-36).
 import copy as _copy
 import struct as _struct
 
+import numpy as _np
+
 from . import schema
 
 
 class RepeatedField(list):
     """List that coerces scalar appends to the field's proto type (so e.g.
-    float fields are f32-quantized no matter how values arrive)."""
+    float fields are f32-quantized no matter how values arrive).
 
-    __slots__ = ("_owner", "_ftype")
+    Packed numeric data (blob weights — tens of millions of floats for a
+    CaffeNet) additionally lives in lazy numpy ``_chunks``: the wire codec
+    appends raw arrays via ``extend_np`` and reads them back zero-copy via
+    ``__array__``, so a .caffemodel import/export never materializes one
+    Python float object per weight. Any list-style access materializes the
+    chunks first, preserving exact list semantics."""
+
+    __slots__ = ("_owner", "_ftype", "_chunks")
 
     def __init__(self, owner, ftype, values=()):
         self._owner = owner
         self._ftype = ftype
-        super().__init__(owner._coerce(ftype, v) for v in values)
+        self._chunks = None
+        if isinstance(values, RepeatedField) and values._ftype == ftype:
+            # same-type copy (Message.copy fast path): elements are already
+            # coerced; share the immutable numpy chunks
+            super().__init__(list.__iter__(values))
+            if values._chunks:
+                self._chunks = list(values._chunks)
+        else:
+            super().__init__(owner._coerce(ftype, v) for v in values)
+
+    # -- numpy fast paths --------------------------------------------------
+    def extend_np(self, arr):
+        """Bulk extend from a numpy array of already-exact values (wire
+        decode / array_to_blob). Stored as a chunk; materialized lazily."""
+        if arr.size == 0:
+            return
+        if self._chunks is None:
+            self._chunks = []
+        self._chunks.append(arr)
+
+    def __array__(self, dtype=None, copy=None):
+        if self._chunks and not list.__len__(self):
+            arr = self._chunks[0] if len(self._chunks) == 1 \
+                else _np.concatenate(self._chunks)
+            return _np.asarray(arr, dtype) if dtype is not None \
+                else _np.asarray(arr)
+        self._materialize()
+        return _np.asarray(list(self), dtype=dtype)
+
+    def _materialize(self):
+        if self._chunks:
+            chunks, self._chunks = self._chunks, None
+            arr = chunks[0] if len(chunks) == 1 else _np.concatenate(chunks)
+            list.extend(self, arr.tolist())
+
+    # -- list protocol (chunk-aware) ---------------------------------------
+    def __len__(self):
+        n = list.__len__(self)
+        if self._chunks:
+            n += sum(c.size for c in self._chunks)
+        return n
+
+    def __iter__(self):
+        self._materialize()
+        return list.__iter__(self)
+
+    def __getitem__(self, i):
+        self._materialize()
+        return list.__getitem__(self, i)
+
+    def __delitem__(self, i):
+        self._materialize()
+        list.__delitem__(self, i)
+
+    def __contains__(self, v):
+        self._materialize()
+        return list.__contains__(self, v)
+
+    def __eq__(self, other):
+        self._materialize()
+        if isinstance(other, RepeatedField):
+            other._materialize()
+        return list.__eq__(self, other)
+
+    def __ne__(self, other):
+        return not self.__eq__(other)
+
+    __hash__ = None
+
+    def __repr__(self):
+        self._materialize()
+        return list.__repr__(self)
 
     def append(self, v):
+        self._materialize()
         super().append(self._owner._coerce(self._ftype, v))
 
     def extend(self, values):
+        self._materialize()
         super().extend(self._owner._coerce(self._ftype, v) for v in values)
 
     def insert(self, i, v):
+        self._materialize()
         super().insert(i, self._owner._coerce(self._ftype, v))
 
+    def pop(self, *a):
+        self._materialize()
+        return super().pop(*a)
+
+    def remove(self, v):
+        self._materialize()
+        super().remove(v)
+
+    def index(self, *a):
+        self._materialize()
+        return super().index(*a)
+
+    def count(self, v):
+        self._materialize()
+        return super().count(v)
+
+    def sort(self, **kw):
+        self._materialize()
+        super().sort(**kw)
+
+    def reverse(self):
+        self._materialize()
+        super().reverse()
+
+    def clear(self):
+        self._chunks = None
+        super().clear()
+
     def __setitem__(self, i, v):
+        self._materialize()
         if isinstance(i, slice):
             v = [self._owner._coerce(self._ftype, x) for x in v]
         else:
@@ -43,6 +155,7 @@ class RepeatedField(list):
     def extend_raw(self, values):
         """Bulk extend without per-element coercion (wire decode fast path —
         values are already exact)."""
+        self._materialize()
         super().extend(values)
 
 
@@ -188,10 +301,14 @@ class Message:
             num, ftype, label, default = self.spec(name)
             val = self._fields[name]
             if label != "opt":
-                new._fields[name] = RepeatedField(
-                    new, ftype,
-                    (_copy.deepcopy(v, memo) if isinstance(v, Message) else v
-                     for v in val))
+                if schema.is_message(ftype):
+                    new._fields[name] = RepeatedField(
+                        new, ftype,
+                        [_copy.deepcopy(v, memo) for v in val])
+                else:
+                    # scalar repeated: same-ftype fast path (no re-coerce,
+                    # numpy chunks shared instead of materialized)
+                    new._fields[name] = RepeatedField(new, ftype, val)
             elif isinstance(val, Message):
                 new._fields[name] = _copy.deepcopy(val, memo)
             else:

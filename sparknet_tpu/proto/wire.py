@@ -120,11 +120,12 @@ def _decode(buf, pos, end, type_name):
             stop = pos + n
             tgt = getattr(msg, name)
             if ftype == "float":
-                tgt.extend_raw(np.frombuffer(buf[pos:stop], dtype="<f4")
-                               .astype(np.float64).tolist())
+                # stays numpy until someone needs list semantics
+                # (RepeatedField lazy chunks) — the .caffemodel fast path
+                tgt.extend_np(np.frombuffer(buf[pos:stop], dtype="<f4"))
                 pos = stop
             elif ftype == "double":
-                tgt.extend_raw(np.frombuffer(buf[pos:stop], dtype="<f8").tolist())
+                tgt.extend_np(np.frombuffer(buf[pos:stop], dtype="<f8"))
                 pos = stop
             else:
                 while pos < stop:

@@ -78,9 +78,15 @@ def save_state_hdf5(path, iter_, learned_net, net, history,
                     current_step=0):
     h5 = _h5py()
     with h5.File(path, "w") as f:
-        f.create_dataset("iter", data=np.int64(iter_))
-        f.create_dataset("learned_net", data=learned_net)
-        f.create_dataset("current_step", data=np.int64(current_step))
+        # caffe's hdf5_save_int writes native int (32-bit) and the
+        # learned_net string as fixed-length C chars (util/hdf5.cpp
+        # hdf5_save_string) — match exactly so old H5LT readers accept it
+        f.create_dataset("iter", data=np.int32(iter_))
+        f.create_dataset("learned_net",
+                         data=np.bytes_(learned_net.encode()
+                                        if isinstance(learned_net, str)
+                                        else learned_net))
+        f.create_dataset("current_step", data=np.int32(current_step))
         g = f.create_group("history")
         for n, (lname, i, s) in enumerate(history_order(net, history)):
             g.create_dataset(str(n),
@@ -93,13 +99,21 @@ def load_state_hdf5(path, net, history):
     h5 = _h5py()
     import jax.numpy as jnp
     new_history = {k: [list(slot) for slot in v] for k, v in history.items()}
+    order = list(history_order(net, history))
     with h5.File(path, "r") as f:
         it = int(np.asarray(f["iter"]))
         learned = f["learned_net"][()]
         if isinstance(learned, bytes):
             learned = learned.decode()
         g = f["history"]
-        for n, (lname, i, s) in enumerate(history_order(net, history)):
+        if len(g) != len(order):
+            # caffe CHECK_EQ(state_history_size, history_.size()): e.g. a
+            # 1-slot SGD state restored into a 2-slot Adam solver
+            raise ValueError(
+                f"{path}: solver state has {len(g)} history blobs, this "
+                f"solver ({len(order)} expected) is a different type — "
+                f"restore with the solver type that wrote the snapshot")
+        for n, (lname, i, s) in enumerate(order):
             ref = new_history[lname][i][s]
             arr = np.asarray(g[str(n)])
             new_history[lname][i][s] = jnp.asarray(

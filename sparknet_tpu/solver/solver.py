@@ -151,16 +151,31 @@ class Solver:
     # -- public API --------------------------------------------------------
     def check_batch(self, batch, leading=()):
         """Fail fast with blob names when a feed array has the wrong shape
-        (otherwise the error is a cryptic reshape deep inside some layer)."""
+        (otherwise the error is a cryptic reshape deep inside some layer).
+        Multi-process: each host feeds its 1/process_count slice of the
+        batch axis (shard_batch assembles the global array), so the
+        expected leading batch dim shrinks accordingly."""
+        pcount = jax.process_count()
         for name, want in self.net.feed_shapes().items():
             if name not in batch:
                 raise ValueError(f"batch missing feed blob {name!r} "
                                  f"(needs {sorted(self.net.feed_shapes())})")
             got = tuple(np.shape(batch[name]))
-            if got != tuple(leading) + tuple(want):
+            expect = tuple(leading) + tuple(want)
+            if pcount > 1 and expect:
+                bd = len(leading)
+                if expect[bd] % pcount:
+                    raise ValueError(
+                        f"feed blob {name!r}: global batch {expect[bd]} not "
+                        f"divisible by {pcount} hosts")
+                expect = expect[:bd] + (expect[bd] // pcount,) \
+                    + expect[bd + 1:]
+            if got != expect:
                 raise ValueError(
                     f"feed blob {name!r}: got shape {got}, net was compiled "
-                    f"for {tuple(leading) + tuple(want)}")
+                    f"for {expect}"
+                    + (f" (this host's slice of {pcount} hosts)"
+                       if pcount > 1 else ""))
 
     def train_step(self, batch):
         """One optimization step; returns the (unsmoothed) loss value."""
@@ -275,8 +290,15 @@ class Solver:
         blobs = list(ss.history)
         new_history = {k: [list(slot) for slot in v]
                        for k, v in self.history.items()}
-        for n, (lname, i, s) in enumerate(
-                hdf5_io.history_order(self.net, self.history)):
+        order = list(hdf5_io.history_order(self.net, self.history))
+        if len(blobs) != len(order):
+            # caffe SGDSolver::RestoreSolverStateFromBinaryProto
+            # CHECK_EQ(state.history_size(), history_.size())
+            raise ValueError(
+                f"{state_path}: solver state has {len(blobs)} history "
+                f"blobs, this solver expects {len(order)} — it was written "
+                f"by a different solver type")
+        for n, (lname, i, s) in enumerate(order):
             ref = new_history[lname][i][s]
             arr = blob_to_array(blobs[n]).reshape(ref.shape)
             new_history[lname][i][s] = jnp.asarray(arr, ref.dtype)

@@ -1,0 +1,137 @@
+"""Dataset preparation tools — the reference's tools/ binaries.
+
+  convert_cifar_data   examples/cifar10/convert_cifar_data.cpp: CIFAR-10
+                       binary batches -> train/test Datum DBs
+  compute_image_mean   tools/compute_image_mean.cpp: Datum DB -> mean image
+                       .binaryproto (+ per-channel means printed)
+  convert_imageset     tools/convert_imageset.cpp: listfile of
+                       "relpath label" lines -> Datum DB (optional resize,
+                       gray, shuffle, encoded passthrough)
+
+All write LMDB via the pure-Python writer (data/lmdb.py); the reference's
+LevelDB option is intentionally not provided (see data/db_source.open_db).
+"""
+
+import os
+
+import numpy as np
+
+from .data.lmdb import LMDBWriter
+from .data.datum import array_to_datum, encoded_datum, datum_to_array
+from .data.transforms import save_mean_binaryproto
+from . import native
+
+_CIFAR_SIZE = 32
+_CIFAR_BYTES = 3 * _CIFAR_SIZE * _CIFAR_SIZE
+_CIFAR_BATCH = 10000
+
+
+def convert_cifar_data(input_folder, output_folder, log=print):
+    """CIFAR-10 binary batches -> cifar10_{train,test}_lmdb of Datums,
+    keys "%05d" in read order (convert_cifar_data.cpp:38-88)."""
+    record = _CIFAR_BYTES + 1
+
+    def write(db_path, files):
+        with LMDBWriter(db_path) as w:
+            idx = 0
+            for f in files:
+                raw = np.fromfile(os.path.join(input_folder, f), np.uint8)
+                images, labels = native.decode_cifar_records(raw, record)
+                images = images.reshape(-1, 3, _CIFAR_SIZE, _CIFAR_SIZE)
+                for img, label in zip(images, labels):
+                    w.put(b"%05d" % idx, array_to_datum(img, int(label)))
+                    idx += 1
+        return idx
+
+    log("Writing Training data")
+    n = write(os.path.join(output_folder, "cifar10_train_lmdb"),
+              [f"data_batch_{i}.bin" for i in range(1, 6)])
+    log(f"  {n} records")
+    log("Writing Testing data")
+    n = write(os.path.join(output_folder, "cifar10_test_lmdb"),
+              ["test_batch.bin"])
+    log(f"  {n} records")
+
+
+def compute_image_mean(db_path, out_path=None, backend="lmdb", log=print):
+    """Mean image over every Datum in a DB -> BlobProto .binaryproto
+    (tools/compute_image_mean.cpp; native accumulate per record)."""
+    from .data.db_source import open_db
+    db = open_db(db_path, backend)
+    acc = None
+    count = 0
+    for _, value in db.items():
+        arr, _ = datum_to_array(value)
+        if arr.dtype != np.uint8:
+            arr = arr.astype(np.float64)
+            acc = arr if acc is None else acc + arr
+        else:
+            if acc is None:
+                acc = np.zeros(arr.shape, np.int64)
+            native.accumulate_sum(arr[None], acc)
+        count += 1
+    db.close()
+    if not count:
+        raise ValueError(f"{db_path}: empty database")
+    mean = (acc / count).astype(np.float32)
+    if out_path:
+        save_mean_binaryproto(mean, out_path)
+        log(f"Write to {out_path}")
+    for ch in range(mean.shape[0]):
+        log(f"mean_value channel [{ch}]: {mean[ch].mean():.6g}")
+    return mean
+
+
+def convert_imageset(root_folder, list_file, db_path, resize_height=0,
+                     resize_width=0, gray=False, shuffle=False,
+                     encoded=False, seed=0, log=print):
+    """Images listed as "relative/path label" lines -> Datum DB.
+
+    Matches tools/convert_imageset.cpp keys ("%08d_<path>") and flags
+    (--resize_height/width, --gray, --shuffle, --encoded). Undecodable
+    images are skipped with a warning, like the reference's
+    ReadImageToDatum false return (and ScaleAndConvert.scala:22-26)."""
+    from PIL import Image
+
+    lines = []
+    with open(list_file) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            path, _, label = line.rpartition(" ")
+            lines.append((path, int(label)))
+    if shuffle:
+        np.random.RandomState(seed).shuffle(lines)
+    log(f"A total of {len(lines)} images.")
+
+    written = 0
+    with LMDBWriter(db_path) as w:
+        for i, (rel, label) in enumerate(lines):
+            full = os.path.join(root_folder, rel)
+            try:
+                if encoded and not (resize_height or resize_width or gray):
+                    with open(full, "rb") as f:
+                        raw = f.read()
+                    datum = encoded_datum(raw, label)
+                else:
+                    img = Image.open(full)
+                    img = img.convert("L" if gray else "RGB")
+                    if resize_height and resize_width:
+                        img = img.resize((resize_width, resize_height),
+                                         Image.BILINEAR)
+                    a = np.asarray(img, np.uint8)
+                    if a.ndim == 2:
+                        a = a[None]            # (1,H,W)
+                    else:
+                        a = a[:, :, ::-1].transpose(2, 0, 1)  # HWC RGB->CHW BGR
+                    datum = array_to_datum(np.ascontiguousarray(a), label)
+            except (OSError, ValueError) as e:
+                log(f"Could not open or find file {full}: {e}")
+                continue
+            w.put(b"%08d_%s" % (i, rel.encode()), datum)
+            written += 1
+            if written % 1000 == 0:
+                log(f"Processed {written} files.")
+    log(f"Processed {written} files.")
+    return written

@@ -1,0 +1,153 @@
+"""V0 -> V2 NetParameter upgrade (reference util/upgrade_proto.cpp:93-584)."""
+
+import numpy as np
+import jax
+import pytest
+
+from sparknet_tpu.proto import text_format, Message
+from sparknet_tpu.graph import (CompiledNet, upgrade_net, upgrade_v0,
+                                needs_v0_upgrade, TRAIN)
+
+V0_NET = """
+name: "v0_lenet"
+input: "data"
+input_dim: 4 input_dim: 2 input_dim: 24 input_dim: 24
+input: "label"
+input_dim: 4 input_dim: 1 input_dim: 1 input_dim: 1
+layers {
+  layer { name: "pad1" type: "padding" pad: 2 }
+  bottom: "data" top: "pad1"
+}
+layers {
+  layer {
+    name: "conv1" type: "conv" num_output: 8 kernelsize: 5 stride: 1
+    group: 2 biasterm: true
+    weight_filler { type: "gaussian" std: 0.01 }
+  }
+  bottom: "pad1" top: "conv1"
+}
+layers {
+  layer { name: "relu1" type: "relu" }
+  bottom: "conv1" top: "conv1"
+}
+layers {
+  layer { name: "pool1" type: "pool" pool: MAX kernelsize: 2 stride: 2 }
+  bottom: "conv1" top: "pool1"
+}
+layers {
+  layer { name: "norm1" type: "lrn" local_size: 3 alpha: 5e-05 beta: 0.75 }
+  bottom: "pool1" top: "norm1"
+}
+layers {
+  layer { name: "drop1" type: "dropout" dropout_ratio: 0.3 }
+  bottom: "norm1" top: "norm1"
+}
+layers {
+  layer {
+    name: "ip1" type: "innerproduct" num_output: 10
+    blobs_lr: 1.0 blobs_lr: 2.0 weight_decay: 1.0 weight_decay: 0.0
+  }
+  bottom: "norm1" top: "ip1"
+}
+layers {
+  layer { name: "loss" type: "softmax_loss" }
+  bottom: "ip1" bottom: "label" top: "loss"
+}
+"""
+
+
+def test_needs_and_field_mapping():
+    net = text_format.loads(V0_NET, "NetParameter")
+    assert needs_v0_upgrade(net)
+    v1 = upgrade_v0(net)
+    assert not needs_v0_upgrade(v1)
+    by_name = {l.name: l for l in v1.layers}
+    conv = by_name["conv1"]
+    assert conv.enum_name("type") == "CONVOLUTION"
+    assert int(conv.convolution_param.num_output) == 8
+    # pad/kernel_size/stride are repeated in the shared ConvolutionParameter
+    # (the reference's UpgradeV0LayerParameter add_pad()s them)
+    assert list(conv.convolution_param.kernel_size) == [5]
+    assert int(conv.convolution_param.group) == 2
+    # the padding layer was fused: pad=2 moved in, bottom rewired to data
+    assert list(conv.convolution_param.pad) == [2]
+    assert list(conv.bottom) == ["data"]
+    assert "pad1" not in by_name
+    pool = by_name["pool1"]
+    assert pool.pooling_param.enum_name("pool") == "MAX"
+    assert int(pool.pooling_param.kernel_size) == 2
+    lrn = by_name["norm1"]
+    assert int(lrn.lrn_param.local_size) == 3
+    assert abs(float(lrn.lrn_param.alpha) - 5e-05) < 1e-9
+    assert abs(float(by_name["drop1"].dropout_param.dropout_ratio) - 0.3) \
+        < 1e-6
+    ip = by_name["ip1"]
+    assert int(ip.inner_product_param.num_output) == 10
+    assert list(ip.blobs_lr) == [1.0, 2.0]
+    assert by_name["loss"].enum_name("type") == "SOFTMAX_LOSS"
+
+
+def test_v0_net_compiles_and_runs():
+    """The whole chain: V0 text -> V2 -> jitted forward."""
+    net = text_format.loads(V0_NET, "NetParameter")
+    v2 = upgrade_net(net)
+    assert len(v2.layer) == 7 and not v2.layers
+    cn = CompiledNet(v2, TRAIN)
+    params, state = cn.init(jax.random.PRNGKey(0))
+    rs = np.random.RandomState(0)
+    loss, _ = cn.loss_fn(params, state,
+                         {"data": rs.randn(4, 2, 24, 24).astype(np.float32),
+                          "label": rs.randint(0, 10, (4, 1, 1, 1))},
+                         jax.random.PRNGKey(1))
+    assert np.isfinite(float(loss))
+    # padded conv: 24 + 2*2 - 5 + 1 = 24 -> pool /2 -> 12
+    assert cn.blob_shapes["pool1"] == (4, 8, 12, 12)
+
+
+def test_v0_data_layer_transform_migration():
+    """V0 data fields (scale/meanfile/cropsize/mirror) land in
+    transform_param; source/batchsize in data_param; and deprecated
+    V1-level DataParameter transform fields migrate too."""
+    txt = """
+    name: "d"
+    layers {
+      layer {
+        name: "data" type: "data" source: "some_lmdb" batchsize: 32
+        scale: 0.5 meanfile: "m.binaryproto" cropsize: 20 mirror: true
+        rand_skip: 5
+      }
+      top: "data" top: "label"
+    }
+    """
+    net = text_format.loads(txt, "NetParameter")
+    v2 = upgrade_net(net)
+    lp = v2.layer[0]
+    assert lp.type == "Data"
+    assert lp.data_param.source == "some_lmdb"
+    assert int(lp.data_param.batch_size) == 32
+    assert int(lp.data_param.rand_skip) == 5
+    tp = lp.transform_param
+    assert abs(float(tp.scale) - 0.5) < 1e-6
+    assert tp.mean_file == "m.binaryproto"
+    assert int(tp.crop_size) == 20 and bool(tp.mirror)
+    # not duplicated on the data_param (reference clears them on upgrade)
+    assert not lp.data_param.has("scale")
+    assert not lp.data_param.has("mean_file")
+
+
+def test_padding_fusion_rejects_bad_consumer():
+    txt = """
+    name: "bad"
+    input: "data"
+    layers {
+      layer { name: "pad1" type: "padding" pad: 1 }
+      bottom: "data" top: "p"
+    }
+    layers {
+      layer { name: "r" type: "relu" }
+      bottom: "p" top: "r"
+    }
+    """
+    net = text_format.loads(txt, "NetParameter")
+    with pytest.raises(ValueError, match="non-conv/pool"):
+        upgrade_v0(net)
