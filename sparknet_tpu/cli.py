@@ -130,10 +130,13 @@ def cmd_train(args):
         solver = DataParallelSolver(sp, mesh=make_mesh(_mesh_arg(args.mesh))
                                     if args.mesh else None, base_dir=base_dir,
                                     feed_shapes=feed or None,
-                                    test_feed_shapes=test_shapes)
+                                    test_feed_shapes=test_shapes,
+                                    metrics=args.metrics)
     else:
         solver = Solver(sp, base_dir=base_dir, feed_shapes=feed or None,
-                        test_feed_shapes=test_shapes)
+                        test_feed_shapes=test_shapes, metrics=args.metrics)
+    if args.stall_seconds:
+        solver.arm_watchdog(stall_seconds=args.stall_seconds)
     if args.weights:
         solver.load_weights(args.weights)
     if args.snapshot:
@@ -231,6 +234,13 @@ def cmd_test(args):
 def cmd_convert_cifar(args):
     from . import tools
     tools.convert_cifar_data(args.input, args.output)
+    return 0
+
+
+def cmd_make_synth_cifar(args):
+    from . import tools
+    tools.make_synth_cifar(args.output, n_train=args.train, n_test=args.test,
+                           seed=args.seed, noise=args.noise)
     return 0
 
 
@@ -347,6 +357,9 @@ def main(argv=None):
     t.add_argument("--input-shape", action="append", default=[],
                    help='feed blob shape hint, e.g. "data=100,3,32,32" '
                         "(stands in for the LMDB record shape)")
+    t.add_argument("--metrics", help="JSONL metrics output path")
+    t.add_argument("--stall-seconds", type=float, default=0,
+                   help="arm a stall/NaN watchdog with this timeout")
     t.add_argument("--sigint_effect", default="stop",
                    choices=("snapshot", "stop", "none"))
     t.add_argument("--sighup_effect", default="snapshot",
@@ -374,6 +387,16 @@ def main(argv=None):
     cc.add_argument("input", help="dir with data_batch_*.bin + test_batch.bin")
     cc.add_argument("output", help="dir to create cifar10_{train,test}_lmdb")
     cc.set_defaults(fn=cmd_convert_cifar)
+
+    ms = sub.add_parser("make_synth_cifar",
+                        help="synthetic CIFAR-format dataset (zero-egress "
+                             "stand-in for get_cifar10.sh)")
+    ms.add_argument("output", help="dir for data_batch_*.bin/test_batch.bin")
+    ms.add_argument("--train", type=int, default=50000)
+    ms.add_argument("--test", type=int, default=10000)
+    ms.add_argument("--seed", type=int, default=0)
+    ms.add_argument("--noise", type=float, default=28.0)
+    ms.set_defaults(fn=cmd_make_synth_cifar)
 
     cm = sub.add_parser("compute_image_mean",
                         help="Datum DB -> mean image .binaryproto")

@@ -101,34 +101,62 @@ def phase_data_layers(net_param, phase):
     from ..graph.compiler import filter_net
     out = []
     for lp in filter_net(net_param, phase).layer:
-        if lp.type in ("Data", "ImageData"):
+        if lp.type in ("Data", "ImageData", "HDF5Data"):
             out.append(lp)
     return out
 
 
+def _resolve(path, base_dir):
+    return os.path.join(base_dir, path) \
+        if base_dir and not os.path.isabs(path) else path
+
+
 def build_db_feed(net_param, phase, base_dir="", seed=None):
-    """If the net's phase-filtered Data layer points at an existing LMDB,
-    return (feed_shapes, source); else (None, None) — the caller falls back
-    to synthetic feeds. This is what lets `sparknet train --solver
+    """If the net's phase-filtered data layer points at an existing source
+    (Data -> LMDB, ImageData -> listfile, HDF5Data -> list-of-h5), return
+    (feed_shapes, source); else (None, None) — the caller falls back to
+    synthetic feeds. This is what lets `sparknet train --solver
     cifar10_full_solver.prototxt` run the reference's most basic flow:
     stock prototxt -> real records -> trained net."""
+    from .file_sources import ImageDataSource, HDF5DataSource
     for lp in phase_data_layers(net_param, phase):
-        if lp.type != "Data" or not lp.has("data_param"):
-            continue
-        dp = lp.data_param
-        source = dp.source
-        if base_dir and not os.path.isabs(source):
-            source = os.path.join(base_dir, source)
-        if not os.path.exists(_db_file(source)):
-            continue
         tops = list(lp.top)
-        src = DatumBatchSource(
-            source, int(dp.batch_size), phase=phase,
-            transform_param=lp.transform_param
-            if lp.has("transform_param") else None,
-            backend=int(dp.backend) if dp.has("backend") else "lmdb",
-            rand_skip=int(dp.rand_skip), base_dir=base_dir, seed=seed,
-            data_top=tops[0], label_top=tops[1] if len(tops) > 1 else "label")
+        tp = lp.transform_param if lp.has("transform_param") else None
+        if lp.type == "Data" and lp.has("data_param"):
+            dp = lp.data_param
+            source = _resolve(dp.source, base_dir)
+            if not os.path.exists(_db_file(source)):
+                continue
+            src = DatumBatchSource(
+                source, int(dp.batch_size), phase=phase, transform_param=tp,
+                backend=int(dp.backend) if dp.has("backend") else "lmdb",
+                rand_skip=int(dp.rand_skip), base_dir=base_dir, seed=seed,
+                data_top=tops[0],
+                label_top=tops[1] if len(tops) > 1 else "label")
+        elif lp.type == "ImageData" and lp.has("image_data_param"):
+            ip = lp.image_data_param
+            source = _resolve(ip.source, base_dir)
+            if not os.path.exists(source):
+                continue
+            src = ImageDataSource(
+                source, int(ip.batch_size), phase=phase, transform_param=tp,
+                root_folder=_resolve(ip.root_folder, base_dir)
+                if ip.root_folder else base_dir,
+                new_height=int(ip.new_height), new_width=int(ip.new_width),
+                is_color=bool(int(ip.is_color)), shuffle=bool(int(ip.shuffle)),
+                rand_skip=int(ip.rand_skip), base_dir=base_dir, seed=seed,
+                data_top=tops[0],
+                label_top=tops[1] if len(tops) > 1 else "label")
+        elif lp.type == "HDF5Data" and lp.has("hdf5_data_param"):
+            hp = lp.hdf5_data_param
+            source = _resolve(hp.source, base_dir)
+            if not os.path.exists(source):
+                continue
+            src = HDF5DataSource(source, int(hp.batch_size), tops,
+                                 shuffle=bool(int(hp.shuffle)), seed=seed)
+            return dict(src.shape), src
+        else:
+            continue
         shapes = {tops[0]: src.shape}
         if len(tops) > 1:
             shapes[tops[1]] = (src.batch_size,)

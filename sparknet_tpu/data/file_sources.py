@@ -1,0 +1,228 @@
+"""File-backed batch sources: ImageData, HDF5Data, MemoryData.
+
+Host-side equivalents of the reference's non-DB data layers — each yields
+ready feed dicts, like DatumBatchSource, for the training loop (or a
+PrefetchIterator) to device_put:
+
+  ImageDataSource   image_data_layer.cpp: listfile of "path label" lines,
+                    optional resize/gray, shuffle-on-epoch, transform_param
+  HDF5DataSource    hdf5_data_layer.cpp: source file listing .h5 files whose
+                    datasets are keyed by top name; row shuffle per file
+  MemoryDataSource  memory_data_layer.cpp: in-memory arrays via Reset()
+
+The graph-side shape stubs live in ops/feed.py; build_feed (db_source.py)
+dispatches a net's data layers to these classes.
+"""
+
+import os
+
+import numpy as np
+
+from .transforms import DataTransformer
+
+
+class ImageDataSource:
+    """Infinite batched iterator over a listfile of images.
+
+    Matches reference ImageDataLayer: lines are "relative/path label";
+    new_height/new_width force-resize; is_color selects RGB vs gray;
+    shuffle reshuffles the line order on every epoch wrap (ShuffleImages);
+    rand_skip advances once at startup; transform_param applies
+    crop/mirror/scale/mean per batch. Images are decoded to CHW BGR uint8,
+    the reference's OpenCV convention, so stock mean files line up.
+    """
+
+    def __init__(self, source, batch_size, phase=0, transform_param=None,
+                 root_folder="", new_height=0, new_width=0, is_color=True,
+                 shuffle=False, rand_skip=0, base_dir="", seed=None,
+                 data_top="data", label_top="label"):
+        from PIL import Image       # decode dependency kept out of import
+        self._Image = Image
+        self.source = source
+        self.batch_size = int(batch_size)
+        self.root = root_folder
+        self.new_height, self.new_width = int(new_height), int(new_width)
+        if (self.new_height > 0) != (self.new_width > 0):
+            raise ValueError("new_height and new_width must be set together "
+                             "(image_data_layer.cpp CHECK)")
+        self.is_color = bool(is_color)
+        self.shuffle = bool(shuffle)
+        self.data_top, self.label_top = data_top, label_top
+        self.rng = np.random.RandomState(seed)
+        self.transformer = DataTransformer(transform_param, phase=phase,
+                                           base_dir=base_dir, rng=self.rng)
+        self.lines = []
+        with open(source) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                path, _, label = line.rpartition(" ")
+                self.lines.append((path, int(label)))
+        if not self.lines:
+            raise ValueError(f"{source}: empty image list")
+        if self.shuffle:
+            self.rng.shuffle(self.lines)
+        self._skip = int(self.rng.randint(0, rand_skip)) if rand_skip else 0
+        first = self._read(self.lines[0][0])
+        self.record_shape = first.shape
+        self.shape = (self.batch_size,) + \
+            self.transformer.output_shape(self.record_shape)
+
+    @property
+    def num_batches(self):
+        return max(1, len(self.lines) // self.batch_size)
+
+    def _read(self, rel):
+        img = self._Image.open(os.path.join(self.root, rel))
+        img = img.convert("RGB" if self.is_color else "L")
+        if self.new_height and self.new_width:
+            img = img.resize((self.new_width, self.new_height),
+                             self._Image.BILINEAR)
+        a = np.asarray(img, np.uint8)
+        if a.ndim == 2:
+            return a[None]                      # (1,H,W)
+        return np.ascontiguousarray(a[:, :, ::-1].transpose(2, 0, 1))
+
+    def _records(self):
+        skip = self._skip
+        self._skip = 0
+        while True:
+            for rel, label in self.lines:
+                if skip:
+                    skip -= 1
+                    continue
+                yield self._read(rel), label
+            if self.shuffle:                    # reshuffle on wrap
+                self.rng.shuffle(self.lines)
+
+    def __iter__(self):
+        rec = self._records()
+        while True:
+            arrs = []
+            labels = np.empty(self.batch_size, np.int32)
+            for i in range(self.batch_size):
+                a, labels[i] = next(rec)
+                if a.shape != self.record_shape:
+                    raise ValueError(
+                        f"image shape {a.shape} != first image "
+                        f"{self.record_shape}; set new_height/new_width to "
+                        "force a common size")
+                arrs.append(a)
+            yield {self.data_top: self.transformer(np.stack(arrs)),
+                   self.label_top: labels}
+
+    def close(self):
+        pass
+
+
+class HDF5DataSource:
+    """Infinite batched iterator over HDF5 files listed in ``source``.
+
+    Matches reference HDF5DataLayer: every top name is a dataset in each
+    file; batches are sliced along axis 0; ``shuffle`` permutes the file
+    order and the rows within each file per epoch. No transform_param (the
+    reference layer has none). Labels come through as-is (float or int).
+    """
+
+    def __init__(self, source, batch_size, tops, shuffle=False, seed=None):
+        import h5py
+        self._h5py = h5py
+        self.source = source
+        self.batch_size = int(batch_size)
+        self.tops = list(tops)
+        self.shuffle = bool(shuffle)
+        self.rng = np.random.RandomState(seed)
+        with open(source) as f:
+            self.files = [ln.strip() for ln in f if ln.strip()]
+        if not self.files:
+            raise ValueError(f"{source}: lists no HDF5 files")
+        base = os.path.dirname(os.path.abspath(source))
+        self.files = [p if os.path.isabs(p) else os.path.join(base, p)
+                      for p in self.files]
+        self.shapes = {}
+        self._count = 0
+        for p in self.files:
+            with h5py.File(p, "r") as f:
+                n = None
+                for t in self.tops:
+                    if t not in f:
+                        raise KeyError(f"{p}: no dataset {t!r}")
+                    if n is None:
+                        n = f[t].shape[0]
+                        self._count += n
+                    elif f[t].shape[0] != n:
+                        raise ValueError(f"{p}: dataset {t!r} rows "
+                                         f"{f[t].shape[0]} != {n}")
+                    self.shapes.setdefault(t, tuple(f[t].shape[1:]))
+        self.shape = {t: (self.batch_size,) + s
+                      for t, s in self.shapes.items()}
+
+    @property
+    def num_batches(self):
+        return max(1, self._count // self.batch_size)
+
+    def _rows(self):
+        files = list(self.files)
+        while True:
+            if self.shuffle:
+                self.rng.shuffle(files)
+            for p in files:
+                with self._h5py.File(p, "r") as f:
+                    data = {t: np.asarray(f[t]) for t in self.tops}
+                n = len(data[self.tops[0]])
+                order = self.rng.permutation(n) if self.shuffle \
+                    else np.arange(n)
+                for i in order:
+                    yield {t: data[t][i] for t in self.tops}
+
+    def __iter__(self):
+        rows = self._rows()
+        while True:
+            batch = [next(rows) for _ in range(self.batch_size)]
+            yield {t: np.stack([b[t] for b in batch]) for t in self.tops}
+
+    def close(self):
+        pass
+
+
+class MemoryDataSource:
+    """In-memory array feed (reference MemoryDataLayer::Reset). Batches
+    cycle over the arrays; Reset() swaps them (sizes must stay divisible
+    by batch_size, like the reference CHECK)."""
+
+    def __init__(self, batch_size, data=None, labels=None,
+                 data_top="data", label_top="label"):
+        self.batch_size = int(batch_size)
+        self.data_top, self.label_top = data_top, label_top
+        self._pos = 0
+        self.data = self.labels = None
+        if data is not None:
+            self.reset(data, labels)
+
+    def reset(self, data, labels):
+        data = np.asarray(data)
+        labels = np.asarray(labels)
+        if len(data) != len(labels):
+            raise ValueError(f"data rows {len(data)} != labels {len(labels)}")
+        if len(data) % self.batch_size:
+            raise ValueError(
+                f"size {len(data)} not divisible by batch {self.batch_size} "
+                "(memory_data_layer.cpp CHECK on AddMatVector/Reset)")
+        self.data, self.labels = data, labels
+        self._pos = 0
+
+    def __iter__(self):
+        if self.data is None:
+            raise RuntimeError("MemoryDataSource: call reset(data, labels) "
+                               "before iterating")
+        while True:
+            i = self._pos
+            self._pos = (self._pos + self.batch_size) % len(self.data)
+            yield {self.data_top:
+                   self.data[i:i + self.batch_size].astype(np.float32),
+                   self.label_top:
+                   self.labels[i:i + self.batch_size].astype(np.int32)}
+
+    def close(self):
+        pass

@@ -156,10 +156,30 @@ class DataTransformer:
         return (c, s or h, s or w)
 
     def __call__(self, images):
-        """uint8/float (N,C,H,W) -> float32 (N,C,crop,crop)."""
+        """uint8/float (N,C,H,W) -> float32 (N,C,crop,crop), or (N,C,H,W)
+        when crop_size is 0 (caffe crops are always square; uncropped
+        records keep their full, possibly non-square, extent)."""
         images = np.asarray(images)
         n, c, h, w = images.shape
-        crop = self.crop_size or h
+        if not self.crop_size:
+            # whole-image path, vectorized (the native kernel is a
+            # crop-window kernel; without a crop there's nothing to gather)
+            out = images.astype(np.float32)
+            mean = self.mean
+            if mean is not None and self.full_mean:
+                out -= mean[None]          # source-index == full image
+            if self.mirror:
+                flips = self.rng.randint(0, 2, n).astype(bool)
+                out[flips] = out[flips][:, :, :, ::-1]
+            if mean is not None and not self.full_mean:
+                if mean.ndim == 1 and len(mean) not in (1, c):
+                    raise ValueError(
+                        f"mean_value count {len(mean)} != channels {c}")
+                out -= mean.reshape(1, -1, 1, 1)
+            if self.scale != 1.0:
+                out *= self.scale
+            return out
+        crop = self.crop_size
         if self.crop_size:
             if self.phase == 0:  # TRAIN: random offsets
                 ys = self.rng.randint(0, h - crop + 1, n).astype(np.int32)

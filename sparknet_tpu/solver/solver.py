@@ -64,9 +64,17 @@ class Solver:
 
     def __init__(self, solver_param, net_param=None, feed_shapes=None,
                  test_feed_shapes=None, base_dir="", dtype=jnp.float32,
-                 log_fn=print):
+                 log_fn=print, metrics=None):
         self.param = solver_param
         self.log = log_fn or (lambda *a: None)
+        # structured observability hooks, armed by default from the CLI:
+        # a JSONL MetricsLogger (or path) and an optional Watchdog that
+        # step() beats once per iteration (SURVEY.md section 5 gaps)
+        if isinstance(metrics, str):
+            from ..utils.metrics import MetricsLogger
+            metrics = MetricsLogger(metrics)
+        self.metrics = metrics
+        self.watchdog = None
         train_np, test_np = resolve_nets(solver_param, base_dir, net_param)
         self.net = CompiledNet(train_np, TRAIN, feed_shapes=feed_shapes,
                                dtype=dtype)
@@ -148,6 +156,12 @@ class Solver:
 
         return jax.jit(ev)
 
+    def arm_watchdog(self, stall_seconds=300.0, **kw):
+        """Start a stall/NaN watchdog that step() beats each iteration."""
+        from ..utils.watchdog import Watchdog
+        self.watchdog = Watchdog(stall_seconds=stall_seconds, **kw).start()
+        return self.watchdog
+
     # -- public API --------------------------------------------------------
     def check_batch(self, batch, leading=()):
         """Fail fast with blob names when a feed array has the wrong shape
@@ -199,6 +213,7 @@ class Solver:
         tests (test_data_fn() -> fresh test batch iterator) and snapshots."""
         sp = self.param
         iter_size = int(sp.iter_size)
+        t_last, it_last = time.perf_counter(), self.iter
         for _ in range(num_iters):
             if sp.test_interval and self.iter % sp.test_interval == 0 and \
                     (self.iter > 0 or sp.test_initialization) and \
@@ -206,6 +221,11 @@ class Solver:
                 scores = self.test(test_data_fn())
                 for k, v in scores.items():
                     self.log(f"    Test net output: {k} = {v}")
+                if self.metrics:
+                    self.metrics.log("test", iter=self.iter,
+                                     **{k: float(np.mean(v))
+                                        for k, v in scores.items()})
+                t_last, it_last = time.perf_counter(), self.iter
             if iter_size == 1:
                 batch = next(data_iter)
             else:
@@ -214,10 +234,22 @@ class Solver:
                          for k in micros[0]}
             loss = self.train_step(batch)
             self._smoothed.append(float(loss))
+            if self.watchdog is not None:
+                self.watchdog.beat(loss)
             if sp.display and (self.iter - 1) % sp.display == 0:
                 sm = sum(self._smoothed) / len(self._smoothed)
+                lr = float(self.lr_fn(self.iter - 1))
                 self.log(f"Iteration {self.iter - 1}, loss = {sm:.6g}, "
-                         f"lr = {float(self.lr_fn(self.iter - 1)):.6g}")
+                         f"lr = {lr:.6g}")
+                if self.metrics:
+                    dt = time.perf_counter() - t_last
+                    steps = self.iter - it_last
+                    bsz = next(iter(self.net.feed_shapes().values()), (0,))
+                    self.metrics.log(
+                        "train", iter=self.iter - 1, loss=sm, lr=lr,
+                        images_per_sec=round(steps * iter_size * bsz[0] / dt,
+                                             2) if dt > 0 and bsz else None)
+                    t_last, it_last = time.perf_counter(), self.iter
             if sp.snapshot and self.iter % sp.snapshot == 0 and \
                     sp.has("snapshot_prefix"):
                 self.snapshot()

@@ -1,0 +1,193 @@
+"""ImageData / HDF5Data / MemoryData host sources (reference
+image_data_layer.cpp, hdf5_data_layer.cpp, memory_data_layer.cpp)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from conftest import REFERENCE  # noqa: F401  (conftest sets the cpu env)
+
+from sparknet_tpu.data.file_sources import (
+    ImageDataSource, HDF5DataSource, MemoryDataSource)
+from sparknet_tpu.data.db_source import build_db_feed
+from sparknet_tpu.proto import text_format
+from sparknet_tpu.graph.compiler import TRAIN
+
+
+def _write_images(d, n, size=(8, 10)):
+    """n solid-color PNGs + listfile; returns (listfile path, colors)."""
+    from PIL import Image
+    os.makedirs(d, exist_ok=True)
+    colors = [(int(i * 20 % 256), int(i * 37 % 256), int(i * 53 % 256))
+              for i in range(n)]
+    lines = []
+    for i, c in enumerate(colors):
+        Image.new("RGB", size[::-1], c).save(os.path.join(d, f"im{i}.png"))
+        lines.append(f"im{i}.png {i % 3}")
+    lf = os.path.join(d, "list.txt")
+    with open(lf, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    return lf, colors
+
+
+class TestImageData:
+    def test_batches_bgr_and_labels(self, tmp_path):
+        lf, colors = _write_images(str(tmp_path), 6)
+        src = ImageDataSource(lf, 3, root_folder=str(tmp_path))
+        assert src.shape == (3, 3, 8, 10)
+        b = next(iter(src))
+        assert b["data"].shape == (3, 3, 8, 10)
+        assert list(b["label"]) == [0, 1, 2]
+        # CHW **BGR** (OpenCV convention): channel 0 is blue
+        r, g, bl = colors[1]
+        assert b["data"][1, 0, 0, 0] == bl
+        assert b["data"][1, 2, 0, 0] == r
+
+    def test_resize_and_gray(self, tmp_path):
+        lf, _ = _write_images(str(tmp_path), 2)
+        src = ImageDataSource(lf, 2, root_folder=str(tmp_path),
+                              new_height=5, new_width=7, is_color=False)
+        assert next(iter(src))["data"].shape == (2, 1, 5, 7)
+
+    def test_mismatched_new_dims_raise(self, tmp_path):
+        lf, _ = _write_images(str(tmp_path), 1)
+        with pytest.raises(ValueError, match="together"):
+            ImageDataSource(lf, 1, root_folder=str(tmp_path), new_height=5)
+
+    def test_shuffle_reshuffles_on_wrap(self, tmp_path):
+        lf, _ = _write_images(str(tmp_path), 8)
+        src = ImageDataSource(lf, 8, root_folder=str(tmp_path),
+                              shuffle=True, seed=3)
+        it = iter(src)
+        e1 = sorted(next(it)["label"])          # one full epoch per batch
+        o1 = list(next(it)["label"])
+        o2 = list(next(it)["label"])
+        assert e1 == [0, 0, 0, 1, 1, 1, 2, 2]   # every image each epoch
+        assert o1 != o2 or o1 != e1             # order varies across epochs
+
+    def test_wraps_like_cursor(self, tmp_path):
+        lf, _ = _write_images(str(tmp_path), 4)
+        src = ImageDataSource(lf, 3, root_folder=str(tmp_path))
+        it = iter(src)
+        next(it)
+        assert list(next(it)["label"])[0] == 3 % 3  # 4th image then wrap
+
+    def test_transform_param_crop(self, tmp_path):
+        from sparknet_tpu.proto import Message
+        lf, _ = _write_images(str(tmp_path), 2)
+        tp = Message("TransformationParameter", crop_size=6)
+        src = ImageDataSource(lf, 2, phase=TRAIN, transform_param=tp,
+                              root_folder=str(tmp_path), seed=0)
+        assert src.shape == (2, 3, 6, 6)
+        assert next(iter(src))["data"].shape == (2, 3, 6, 6)
+
+
+def _write_h5(path, n, seed, extra_top=True):
+    import h5py
+    rs = np.random.RandomState(seed)
+    with h5py.File(path, "w") as f:
+        f["data"] = rs.randn(n, 2, 4, 4).astype(np.float32)
+        f["label"] = rs.randint(0, 5, (n,)).astype(np.float32)
+        if extra_top:
+            f["label2"] = rs.randint(0, 5, (n,)).astype(np.float32)
+
+
+class TestHDF5Data:
+    def test_multi_file_multi_top(self, tmp_path):
+        _write_h5(str(tmp_path / "a.h5"), 6, 0)
+        _write_h5(str(tmp_path / "b.h5"), 4, 1)
+        lf = tmp_path / "list.txt"
+        lf.write_text("a.h5\nb.h5\n")                  # relative paths
+        src = HDF5DataSource(str(lf), 5, ["data", "label", "label2"])
+        assert src.shape == {"data": (5, 2, 4, 4), "label": (5,),
+                             "label2": (5,)}
+        assert src.num_batches == 2
+        b = next(iter(src))
+        assert set(b) == {"data", "label", "label2"}
+        assert b["data"].shape == (5, 2, 4, 4)
+
+    def test_rows_cross_file_boundary_in_order(self, tmp_path):
+        import h5py
+        for i, n in ((0, 3), (1, 2)):
+            with h5py.File(str(tmp_path / f"f{i}.h5"), "w") as f:
+                f["data"] = np.arange(i * 10, i * 10 + n, dtype=np.float32)
+        lf = tmp_path / "list.txt"
+        lf.write_text("f0.h5\nf1.h5\n")
+        src = HDF5DataSource(str(lf), 5, ["data"])
+        assert list(next(iter(src))["data"]) == [0, 1, 2, 10, 11]
+
+    def test_shuffle_covers_all_rows(self, tmp_path):
+        import h5py
+        with h5py.File(str(tmp_path / "f.h5"), "w") as f:
+            f["data"] = np.arange(10, dtype=np.float32)
+        lf = tmp_path / "list.txt"
+        lf.write_text("f.h5\n")
+        src = HDF5DataSource(str(lf), 10, ["data"], shuffle=True, seed=0)
+        got = sorted(next(iter(src))["data"])
+        assert got == list(range(10))
+
+    def test_missing_dataset_raises(self, tmp_path):
+        _write_h5(str(tmp_path / "a.h5"), 3, 0, extra_top=False)
+        lf = tmp_path / "list.txt"
+        lf.write_text("a.h5\n")
+        with pytest.raises(KeyError, match="nope"):
+            HDF5DataSource(str(lf), 1, ["data", "nope"])
+
+
+class TestMemoryData:
+    def test_cycles(self):
+        src = MemoryDataSource(2, np.arange(8).reshape(4, 2), np.arange(4))
+        it = iter(src)
+        assert list(next(it)["label"]) == [0, 1]
+        assert list(next(it)["label"]) == [2, 3]
+        assert list(next(it)["label"]) == [0, 1]
+
+    def test_divisibility_check(self):
+        with pytest.raises(ValueError, match="divisible"):
+            MemoryDataSource(3, np.zeros((4, 2)), np.zeros(4))
+
+    def test_reset_swaps(self):
+        src = MemoryDataSource(2, np.zeros((2, 3)), np.array([7, 8]))
+        assert list(next(iter(src))["label"]) == [7, 8]
+        src.reset(np.ones((2, 3)), np.array([1, 2]))
+        assert list(next(iter(src))["label"]) == [1, 2]
+
+
+class TestBuildFeedDispatch:
+    def test_image_data_layer(self, tmp_path):
+        lf, _ = _write_images(str(tmp_path), 4)
+        np_ = text_format.loads(f"""
+            name: "t"
+            layer {{ name: "d" type: "ImageData" top: "data" top: "label"
+                     image_data_param {{ source: "{lf}" batch_size: 2 }} }}
+            layer {{ name: "ip" type: "InnerProduct" bottom: "data"
+                     top: "out" inner_product_param {{ num_output: 3 }} }}
+        """, "NetParameter")
+        shapes, src = build_db_feed(np_, TRAIN, base_dir=str(tmp_path))
+        assert isinstance(src, ImageDataSource)
+        assert shapes == {"data": (2, 3, 8, 10), "label": (2,)}
+        src.close()
+
+    def test_hdf5_data_layer(self, tmp_path):
+        _write_h5(str(tmp_path / "a.h5"), 4, 0, extra_top=False)
+        lf = tmp_path / "list.txt"
+        lf.write_text("a.h5\n")
+        np_ = text_format.loads(f"""
+            name: "t"
+            layer {{ name: "d" type: "HDF5Data" top: "data" top: "label"
+                     hdf5_data_param {{ source: "{lf}" batch_size: 2 }} }}
+        """, "NetParameter")
+        shapes, src = build_db_feed(np_, TRAIN)
+        assert isinstance(src, HDF5DataSource)
+        assert shapes["data"] == (2, 2, 4, 4)
+        src.close()
+
+    def test_missing_source_falls_through(self, tmp_path):
+        np_ = text_format.loads("""
+            name: "t"
+            layer { name: "d" type: "ImageData" top: "data" top: "label"
+                    image_data_param { source: "/nope.txt" batch_size: 2 } }
+        """, "NetParameter")
+        shapes, src = build_db_feed(np_, TRAIN)
+        assert shapes is None and src is None
