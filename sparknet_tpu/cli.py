@@ -144,7 +144,7 @@ def cmd_train(args):
     total = args.iterations or int(sp.max_iter) or 1000
     if train_src is not None:
         print(f"Training from {train_src.source} "
-              f"({len(train_src.db)} records)")
+              f"({train_src.num_records} records)")
         data_iter = PrefetchIterator(iter(train_src), depth=3)
     else:
         print("WARNING: no Data-layer LMDB source found; "
@@ -189,28 +189,17 @@ def cmd_test(args):
     from .solver.solver import Solver, resolve_nets
     from .proto import Message
     from .graph.compiler import TEST
-    from .data.db_source import build_db_feed, phase_data_layers
+    from .data.db_source import resolve_db_feed
 
     net_param = text_format.load(args.model, "NetParameter")
     sp = Message("SolverParameter", base_lr=0.0, lr_policy="fixed",
                  display=0)
     sp.net_param = net_param
 
-    # resolve the TEST Data layer's source relative to the model file,
-    # walking up like _net_base_dir (stock sources are caffe-root-relative)
-    test_shapes = test_src = None
-    layers = phase_data_layers(net_param, TEST)
-    if layers and layers[0].has("data_param"):
-        rel = layers[0].data_param.source
-        d = os.path.dirname(os.path.abspath(args.model))
-        while True:
-            test_shapes, test_src = build_db_feed(net_param, TEST, d)
-            if test_src is not None:
-                break
-            parent = os.path.dirname(d)
-            if parent == d:
-                break
-            d = parent
+    # resolve the TEST data layer's source relative to the model file,
+    # walking up (stock prototxt sources are caffe-root-relative)
+    test_shapes, test_src = resolve_db_feed(
+        net_param, TEST, os.path.dirname(os.path.abspath(args.model)))
     # the (unused) TRAIN net compiles with the test shapes — param shapes
     # don't depend on batch size, and only the TEST net is stepped here
     solver = Solver(sp, feed_shapes=_feed_shapes_arg(args.input_shape)
@@ -218,7 +207,8 @@ def cmd_test(args):
     if args.weights:
         solver.load_weights(args.weights)
     if test_src is not None:
-        print(f"Scoring on {test_src.source} ({len(test_src.db)} records)")
+        print(f"Scoring on {test_src.source} "
+              f"({test_src.num_records} records)")
         it = iter(test_src)
     else:
         print("WARNING: no Data-layer LMDB source found; synthetic batches")
@@ -256,6 +246,30 @@ def cmd_convert_imageset(args):
                            resize_height=args.resize_height,
                            resize_width=args.resize_width, gray=args.gray,
                            shuffle=args.shuffle, encoded=args.encoded)
+    return 0
+
+
+def cmd_upgrade_net_proto(args):
+    from . import tools
+    tools.upgrade_net_proto(args.input, args.output, binary=args.binary)
+    return 0
+
+
+def cmd_upgrade_solver_proto(args):
+    from . import tools
+    tools.upgrade_solver_proto(args.input, args.output)
+    return 0
+
+
+def cmd_extract_features(args):
+    from . import tools
+    blobs = args.blobs.split(",")
+    dbs = args.dbs.split(",")
+    if args.db_type != "lmdb":
+        raise SystemExit("only the lmdb backend is supported "
+                         "(see data/db_source.open_db)")
+    tools.extract_features(args.model, blobs, dbs, args.num_batches,
+                           weights_path=args.weights)
     return 0
 
 
@@ -415,6 +429,33 @@ def main(argv=None):
     ci.add_argument("--shuffle", action="store_true")
     ci.add_argument("--encoded", action="store_true")
     ci.set_defaults(fn=cmd_convert_imageset)
+
+    for verb, bin_ in (("upgrade_net_proto_text", False),
+                       ("upgrade_net_proto_binary", True)):
+        u = sub.add_parser(verb,
+                           help="V0/V1 NetParameter file -> latest format")
+        u.add_argument("input")
+        u.add_argument("output")
+        u.set_defaults(fn=cmd_upgrade_net_proto, binary=bin_)
+
+    us = sub.add_parser("upgrade_solver_proto_text",
+                        help="solver_type enum -> type string")
+    us.add_argument("input")
+    us.add_argument("output")
+    us.set_defaults(fn=cmd_upgrade_solver_proto)
+
+    ef = sub.add_parser("extract_features",
+                        help="forward a net, write named blobs as "
+                             "float-Datum LMDBs")
+    ef.add_argument("--weights", default=None,
+                    help=".caffemodel (optional: random init if absent)")
+    ef.add_argument("model", help="feature-extraction prototxt with a "
+                                  "TEST data layer")
+    ef.add_argument("blobs", help="blob_name1[,name2,...]")
+    ef.add_argument("dbs", help="db_path1[,path2,...]")
+    ef.add_argument("num_batches", type=int)
+    ef.add_argument("db_type", nargs="?", default="lmdb")
+    ef.set_defaults(fn=cmd_extract_features)
 
     c = sub.add_parser("cifar", help="CifarApp driver")
     c.add_argument("--workers", type=int, default=None)

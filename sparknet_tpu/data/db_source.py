@@ -64,6 +64,10 @@ class DatumBatchSource:
             self.transformer.output_shape(self.record_shape)
 
     @property
+    def num_records(self):
+        return len(self.db)
+
+    @property
     def num_batches(self):
         """Batches per full pass (ragged tail wraps, as in the reference)."""
         return max(1, len(self.db) // self.batch_size)
@@ -162,6 +166,25 @@ def build_db_feed(net_param, phase, base_dir="", seed=None):
             shapes[tops[1]] = (src.batch_size,)
         return shapes, src
     return None, None
+
+
+def resolve_db_feed(net_param, phase, start_dir, seed=None):
+    """build_db_feed with the CLI's walk-up source resolution: stock
+    prototxt sources are caffe-root-relative, so try start_dir, then each
+    parent, until a readable source appears. -> (shapes, src), or
+    (None, None) when the net has no phase data layer or no source
+    resolves at any level."""
+    if not phase_data_layers(net_param, phase):
+        return None, None
+    d = os.path.abspath(start_dir or ".")
+    while True:
+        shapes, src = build_db_feed(net_param, phase, d, seed=seed)
+        if src is not None:
+            return shapes, src
+        parent = os.path.dirname(d)
+        if parent == d:
+            return None, None
+        d = parent
 
 
 def _db_file(source):

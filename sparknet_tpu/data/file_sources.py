@@ -70,6 +70,10 @@ class ImageDataSource:
             self.transformer.output_shape(self.record_shape)
 
     @property
+    def num_records(self):
+        return len(self.lines)
+
+    @property
     def num_batches(self):
         return max(1, len(self.lines) // self.batch_size)
 
@@ -159,6 +163,10 @@ class HDF5DataSource:
                       for t, s in self.shapes.items()}
 
     @property
+    def num_records(self):
+        return self._count
+
+    @property
     def num_batches(self):
         return max(1, self._count // self.batch_size)
 
@@ -211,6 +219,10 @@ class MemoryDataSource:
                 "(memory_data_layer.cpp CHECK on AddMatVector/Reset)")
         self.data, self.labels = data, labels
         self._pos = 0
+
+    @property
+    def num_records(self):
+        return 0 if self.data is None else len(self.data)
 
     def __iter__(self):
         if self.data is None:

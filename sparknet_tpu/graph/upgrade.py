@@ -217,6 +217,19 @@ def _upgrade_v0_layer(conn):
     return v1
 
 
+_DATA_PARAM_FIELDS = ("data_param", "image_data_param", "window_data_param")
+_DEPRECATED_TRANSFORM_FIELDS = ("scale", "mean_file", "crop_size", "mirror")
+
+
+def net_needs_data_upgrade(net_param):
+    """True when any V2 layer still carries deprecated transform fields in
+    its data param (upgrade_proto.cpp NetNeedsDataUpgrade :586)."""
+    return any(
+        lp.has(pf) and any(getattr(lp, pf).has(f)
+                           for f in _DEPRECATED_TRANSFORM_FIELDS)
+        for lp in net_param.layer for pf in _DATA_PARAM_FIELDS)
+
+
 def upgrade_data_transform(net_param):
     """Move deprecated DataParameter/ImageDataParameter/WindowDataParameter
     scale/mean_file/crop_size/mirror into the layer's transform_param
@@ -224,17 +237,39 @@ def upgrade_data_transform(net_param):
     V2 `layer` entries, after the V1 upgrade."""
     out = net_param.copy()
     for lp in out.layer:
-        for pf in ("data_param", "image_data_param", "window_data_param"):
+        for pf in _DATA_PARAM_FIELDS:
             if not lp.has(pf):
                 continue
             dp = getattr(lp, pf)
-            for f in ("scale", "mean_file", "crop_size", "mirror"):
+            for f in _DEPRECATED_TRANSFORM_FIELDS:
                 if dp.has(f):
                     if not lp.has("transform_param"):
                         lp.transform_param = \
                             Message("TransformationParameter")
                     setattr(lp.transform_param, f, getattr(dp, f))
                     dp.clear(f)
+    return out
+
+
+def solver_needs_type_upgrade(solver_param):
+    """True when the deprecated SolverType enum field is set
+    (upgrade_proto.cpp SolverNeedsTypeUpgrade :940-946)."""
+    return solver_param.has("solver_type")
+
+
+def upgrade_solver(solver_param):
+    """Deprecated `solver_type` enum -> `type` string
+    (upgrade_proto.cpp UpgradeSolverType :948-990). Returns a new
+    SolverParameter; raises if both old and new fields are set."""
+    from ..solver.updates import SOLVER_TYPES
+    out = solver_param.copy()
+    if out.has("solver_type"):
+        if out.has("type"):
+            raise ValueError(
+                "old solver_type field (enum) and new type field (string) "
+                "cannot both be set")
+        out.type = SOLVER_TYPES[int(out.solver_type)]
+        out.clear("solver_type")
     return out
 
 

@@ -1,0 +1,158 @@
+"""Aux tool parity: the upgrade_* and extract_features binaries
+(reference tools/upgrade_net_proto_{text,binary}.cpp,
+upgrade_solver_proto_text.cpp, extract_features.cpp)."""
+
+import numpy as np
+import pytest
+
+from sparknet_tpu import tools
+from sparknet_tpu.proto import text_format, wire, Message
+from sparknet_tpu.graph.upgrade import (solver_needs_type_upgrade,
+                                        upgrade_solver)
+from sparknet_tpu.data.lmdb import LMDBReader, LMDBWriter
+from sparknet_tpu.data.datum import array_to_datum, datum_to_array
+
+V0_NET = """
+name: "v0_mini"
+input: "data"
+input_dim: 2 input_dim: 3 input_dim: 8 input_dim: 8
+layers {
+  layer {
+    name: "conv1" type: "conv" num_output: 4 kernelsize: 3 stride: 1
+    weight_filler { type: "constant" }
+  }
+  bottom: "data" top: "conv1"
+}
+layers {
+  layer { name: "relu1" type: "relu" }
+  bottom: "conv1" top: "conv1"
+}
+"""
+
+
+# ----------------------------------------------------- solver upgrade ----
+
+def test_upgrade_solver_enum_to_string():
+    sp = Message("SolverParameter", base_lr=0.1, solver_type=2)
+    assert solver_needs_type_upgrade(sp)
+    up = upgrade_solver(sp)
+    assert up.type == "AdaGrad" and not up.has("solver_type")
+    # idempotent on already-new files
+    again = upgrade_solver(up)
+    assert again.type == "AdaGrad"
+
+
+def test_upgrade_solver_both_fields_rejected():
+    sp = Message("SolverParameter", solver_type=0)
+    sp.type = "Adam"
+    with pytest.raises(ValueError):
+        upgrade_solver(sp)
+
+
+def test_upgrade_solver_proto_tool(tmp_path):
+    inp, out = str(tmp_path / "old.prototxt"), str(tmp_path / "new.prototxt")
+    with open(inp, "w") as f:
+        f.write('base_lr: 0.01\nlr_policy: "fixed"\nsolver_type: ADAM\n')
+    tools.upgrade_solver_proto(inp, out, log=lambda *a: None)
+    sp = text_format.load(out, "SolverParameter")
+    assert sp.type == "Adam" and not sp.has("solver_type")
+    # the upgraded file drives a Solver directly
+    from sparknet_tpu.solver.updates import canonical_type
+    assert canonical_type(sp) == "Adam"
+
+
+# -------------------------------------------------------- net upgrade ----
+
+def test_upgrade_net_proto_text_tool(tmp_path):
+    inp, out = str(tmp_path / "v0.prototxt"), str(tmp_path / "v2.prototxt")
+    with open(inp, "w") as f:
+        f.write(V0_NET)
+    tools.upgrade_net_proto(inp, out, log=lambda *a: None)
+    net = text_format.load(out, "NetParameter")
+    assert not net.layers and len(net.layer) == 2
+    assert [lp.type for lp in net.layer] == ["Convolution", "ReLU"]
+    assert net.layer[0].convolution_param.num_output == 4
+
+
+def test_upgrade_net_proto_binary_tool(tmp_path):
+    net = text_format.loads(V0_NET, "NetParameter")
+    inp, out = str(tmp_path / "v0.bin"), str(tmp_path / "v2.bin")
+    wire.dump(net, inp)
+    tools.upgrade_net_proto(inp, out, binary=True, log=lambda *a: None)
+    up = wire.load(out, "NetParameter")
+    assert len(up.layer) == 2 and up.layer[0].type == "Convolution"
+
+
+def test_upgrade_net_data_transform_move(tmp_path):
+    txt = """
+name: "d"
+layer {
+  name: "data" type: "Data" top: "data" top: "label"
+  data_param { source: "x_lmdb" batch_size: 4 crop_size: 5 mirror: true }
+}
+"""
+    inp, out = str(tmp_path / "in.prototxt"), str(tmp_path / "out.prototxt")
+    with open(inp, "w") as f:
+        f.write(txt)
+    tools.upgrade_net_proto(inp, out, log=lambda *a: None)
+    net = text_format.load(out, "NetParameter")
+    lp = net.layer[0]
+    assert lp.transform_param.crop_size == 5 and lp.transform_param.mirror
+    assert not lp.data_param.has("crop_size")
+
+
+# --------------------------------------------------- extract_features ----
+
+MODEL = """
+name: "feat"
+layer {
+  name: "data" type: "Data" top: "data" top: "label"
+  include { phase: TEST }
+  data_param { source: "feat_lmdb" batch_size: 4 }
+}
+layer {
+  name: "ip" type: "InnerProduct" bottom: "data" top: "ip"
+  inner_product_param { num_output: 6
+    weight_filler { type: "gaussian" std: 0.1 } }
+}
+layer { name: "prob" type: "Softmax" bottom: "ip" top: "prob" }
+"""
+
+
+def test_extract_features(tmp_path):
+    rs = np.random.RandomState(0)
+    imgs = rs.randint(0, 256, (10, 1, 4, 4), np.uint8)
+    with LMDBWriter(str(tmp_path / "feat_lmdb")) as w:
+        for i, img in enumerate(imgs):
+            w.put(b"%05d" % i, array_to_datum(img, i % 3))
+    model = tmp_path / "feat.prototxt"
+    model.write_text(MODEL)
+
+    counts = tools.extract_features(
+        str(model), ["ip", "prob"],
+        [str(tmp_path / "ip_db"), str(tmp_path / "prob_db")],
+        num_batches=2, log=lambda *a: None)
+    assert counts == [8, 8]
+
+    with LMDBReader(str(tmp_path / "ip_db")) as r:
+        assert len(r) == 8
+        keys = list(r.keys())
+        assert keys[0] == b"%010d" % 0 and keys[-1] == b"%010d" % 7
+        arr, label = datum_to_array(r.get(b"%010d" % 3))
+        assert arr.shape == (6, 1, 1) and arr.dtype == np.float32
+    with LMDBReader(str(tmp_path / "prob_db")) as r:
+        arr, _ = datum_to_array(r.get(b"%010d" % 0))
+        # softmax rows sum to 1
+        assert abs(float(arr.sum()) - 1.0) < 1e-4
+
+
+def test_extract_features_unknown_blob(tmp_path):
+    rs = np.random.RandomState(0)
+    with LMDBWriter(str(tmp_path / "feat_lmdb")) as w:
+        w.put(b"0", array_to_datum(
+            rs.randint(0, 256, (1, 4, 4), np.uint8), 0))
+    model = tmp_path / "feat.prototxt"
+    model.write_text(MODEL)
+    with pytest.raises(ValueError, match="Unknown feature blob"):
+        tools.extract_features(str(model), ["nope"], ["out_db"], 1,
+                               base_dir=str(tmp_path), log=lambda *a: None)
