@@ -159,11 +159,27 @@ def cmd_train(args):
         sp.snapshot_prefix if sp.has("snapshot_prefix") else None)
     policy = SignalPolicy(sigint=args.sigint_effect,
                           sighup=args.sighup_effect)
+    profiling = profiled = False
     try:
         with policy:
             while solver.iter < total:
+                if args.profile and not profiled and not profiling \
+                        and (solver.iter > 0 or total <= 100):
+                    # skip the compile-heavy first block so the trace shows
+                    # steady-state device time (XLA ops, HBM, infeed);
+                    # single-block runs trace their only block
+                    import jax
+                    jax.profiler.start_trace(args.profile)
+                    profiling = True
                 n = min(100, total - solver.iter)
                 solver.step(n, data_iter, test_data_fn=test_fn)
+                if profiling:
+                    import jax
+                    jax.profiler.stop_trace()
+                    profiling = False
+                    profiled = True
+                    print(f"Wrote profiler trace to {args.profile} "
+                          "(view with tensorboard or xprof)")
                 action = policy.pending()
                 if action == "snapshot":
                     solver.snapshot(prefix=prefix or "snap")
@@ -171,6 +187,14 @@ def cmd_train(args):
                     print("stopping early on signal")
                     break
     finally:
+        if profiling:
+            # flush the trace of the block that raised — it's the one
+            # most worth looking at
+            import jax
+            try:
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
         if train_src is not None:
             data_iter.close()
             train_src.close()
@@ -340,7 +364,7 @@ def cmd_cifar(args):
                    prototxt_dir=args.prototxt_dir, strategy=args.strategy,
                    tau=args.tau, log_path=args.log,
                    metrics_path=args.metrics)
-    app.run(num_rounds=args.rounds)
+    app.run(num_rounds=args.rounds, test_every=args.test_every)
     return 0
 
 
@@ -372,6 +396,10 @@ def main(argv=None):
                    help='feed blob shape hint, e.g. "data=100,3,32,32" '
                         "(stands in for the LMDB record shape)")
     t.add_argument("--metrics", help="JSONL metrics output path")
+    t.add_argument("--profile",
+                   help="write a jax.profiler trace of one steady-state "
+                        "100-iter block to this directory (`caffe time`'s "
+                        "deeper sibling; view with tensorboard/xprof)")
     t.add_argument("--stall-seconds", type=float, default=0,
                    help="arm a stall/NaN watchdog with this timeout")
     t.add_argument("--sigint_effect", default="stop",
@@ -457,6 +485,20 @@ def main(argv=None):
     ef.add_argument("db_type", nargs="?", default="lmdb")
     ef.set_defaults(fn=cmd_extract_features)
 
+    # deprecated tool shims (reference tools/{train,test,finetune}_net.cpp,
+    # net_speed_benchmark.cpp: LOG(FATAL) pointing at the real verb)
+    for verb, repl in (("train_net", "train --solver=... [--snapshot=...]"),
+                       ("test_net", "test --model=... --weights=... "
+                                    "[--iterations=50]"),
+                       ("finetune_net", "train --solver=... --weights=..."),
+                       ("net_speed_benchmark", "time --model=... "
+                                               "[--iterations=50]")):
+        dep = sub.add_parser(verb, help="deprecated")
+        dep.add_argument("rest", nargs="*")
+        dep.set_defaults(fn=lambda a, r=repl: (
+            print(f"Deprecated. Use sparknet {r} instead.", file=sys.stderr),
+            1)[1])
+
     c = sub.add_parser("cifar", help="CifarApp driver")
     c.add_argument("--workers", type=int, default=None)
     c.add_argument("--data", help="dir with CIFAR-10 .bin batches")
@@ -465,6 +507,8 @@ def main(argv=None):
                    default="local_sgd")
     c.add_argument("--tau", type=int, default=10)
     c.add_argument("--rounds", type=int, default=20)
+    c.add_argument("--test-every", type=int, default=10,
+                   help="test every N rounds (CifarApp.scala:98)")
     c.add_argument("--log")
     c.add_argument("--metrics", help="JSONL metrics output path")
     c.set_defaults(fn=cmd_cifar)
