@@ -160,19 +160,22 @@ def cmd_train(args):
     policy = SignalPolicy(sigint=args.sigint_effect,
                           sighup=args.sighup_effect)
     profiling = profiled = False
+    blocks_done = 0
     try:
         with policy:
             while solver.iter < total:
                 if args.profile and not profiled and not profiling \
-                        and (solver.iter > 0 or total <= 100):
-                    # skip the compile-heavy first block so the trace shows
-                    # steady-state device time (XLA ops, HBM, infeed);
+                        and (blocks_done >= 1 or total - solver.iter <= 100):
+                    # skip the compile-heavy first block of THIS process
+                    # (fresh start or snapshot resume alike) so the trace
+                    # shows steady-state device time (XLA ops, HBM, infeed);
                     # single-block runs trace their only block
                     import jax
                     jax.profiler.start_trace(args.profile)
                     profiling = True
                 n = min(100, total - solver.iter)
                 solver.step(n, data_iter, test_data_fn=test_fn)
+                blocks_done += 1
                 if profiling:
                     import jax
                     jax.profiler.stop_trace()
@@ -377,7 +380,23 @@ def cmd_imagenet(args):
     return 0
 
 
+# deprecated tool shims (reference tools/{train,test,finetune}_net.cpp,
+# net_speed_benchmark.cpp: LOG(FATAL) pointing at the real verb). Handled
+# before argparse so legacy flag syntax still reaches the redirect message.
+_DEPRECATED_VERBS = {
+    "train_net": "train --solver=... [--snapshot=...]",
+    "test_net": "test --model=... --weights=... [--iterations=50]",
+    "finetune_net": "train --solver=... --weights=...",
+    "net_speed_benchmark": "time --model=... [--iterations=50]",
+}
+
+
 def main(argv=None):
+    args0 = sys.argv[1:] if argv is None else argv
+    if args0 and args0[0] in _DEPRECATED_VERBS:
+        print(f"Deprecated. Use sparknet {_DEPRECATED_VERBS[args0[0]]} "
+              "instead.", file=sys.stderr)
+        return 1
     p = argparse.ArgumentParser(
         prog="sparknet",
         description="TPU-native SparkNet: train/test/time/apps")
@@ -484,20 +503,6 @@ def main(argv=None):
     ef.add_argument("num_batches", type=int)
     ef.add_argument("db_type", nargs="?", default="lmdb")
     ef.set_defaults(fn=cmd_extract_features)
-
-    # deprecated tool shims (reference tools/{train,test,finetune}_net.cpp,
-    # net_speed_benchmark.cpp: LOG(FATAL) pointing at the real verb)
-    for verb, repl in (("train_net", "train --solver=... [--snapshot=...]"),
-                       ("test_net", "test --model=... --weights=... "
-                                    "[--iterations=50]"),
-                       ("finetune_net", "train --solver=... --weights=..."),
-                       ("net_speed_benchmark", "time --model=... "
-                                               "[--iterations=50]")):
-        dep = sub.add_parser(verb, help="deprecated")
-        dep.add_argument("rest", nargs="*")
-        dep.set_defaults(fn=lambda a, r=repl: (
-            print(f"Deprecated. Use sparknet {r} instead.", file=sys.stderr),
-            1)[1])
 
     c = sub.add_parser("cifar", help="CifarApp driver")
     c.add_argument("--workers", type=int, default=None)
