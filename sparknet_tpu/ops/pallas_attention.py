@@ -83,13 +83,27 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
         lse_ref[0] = m_ref[:] + jnp.log(jnp.maximum(l_ref[:], 1e-30))
 
 
+def _fit_block(block, s):
+    """Largest divisor of the sequence <= the requested block, preferring
+    sublane-aligned (multiple-of-8) divisors; the grid's K/V dimension is
+    sequential, so a collapsed block size pays dispatch latency per tile
+    (the 20x in flash_attention's docstring)."""
+    block = min(block, s)
+    if s % block == 0:
+        return block
+    largest = 1
+    for d in range(block, 0, -1):
+        if s % d == 0:
+            if d % 8 == 0:
+                return d
+            largest = max(largest, d)
+    return largest
+
+
 def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret):
     b, h, s, d = q.shape
-    block_q = min(block_q, s)
-    block_k = min(block_k, s)
-    if s % block_q or s % block_k:
-        raise ValueError(f"sequence {s} must divide blocks "
-                         f"({block_q}, {block_k})")
+    block_q = _fit_block(block_q, s)
+    block_k = _fit_block(block_k, s)
     qf = q.reshape(b * h, s, d)
     kf = k.reshape(b * h, s, d)
     vf = v.reshape(b * h, s, d)
@@ -217,8 +231,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dk_ref, dv_ref,
 def _flash_backward(q, k, v, o, lse, g, causal, scale, block_q, block_k,
                     interpret):
     b, h, s, d = q.shape
-    block_q = min(block_q, s)
-    block_k = min(block_k, s)
+    block_q = _fit_block(block_q, s)
+    block_k = _fit_block(block_k, s)
     qf = q.reshape(b * h, s, d)
     kf = k.reshape(b * h, s, d)
     vf = v.reshape(b * h, s, d)
@@ -264,10 +278,16 @@ def _should_interpret():
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def flash_attention(q, k, v, causal=False, scale=None, block_q=128,
-                    block_k=128):
+def flash_attention(q, k, v, causal=False, scale=None, block_q=512,
+                    block_k=512):
     """Flash attention (B, H, S, D) -> (B, H, S, D); exact, O(block) VMEM
-    in both forward and backward. scale defaults to 1/sqrt(D)."""
+    in both forward and backward. scale defaults to 1/sqrt(D).
+
+    Default blocks are 512x512: the grid's K/V dimension is sequential,
+    so small blocks are dispatch-latency-bound — at S=32k, 512x512 runs
+    the train-grad step 20x faster than 128x128 on a v5e (149 ms vs
+    3.1 s) while still using O(block^2) VMEM (~1 MB of scores). Blocks
+    clamp to S for short sequences."""
     scale = scale if scale is not None else 1.0 / (q.shape[-1] ** 0.5)
     out, _ = _flash_forward(q, k, v, causal, scale, block_q, block_k,
                             _should_interpret())
