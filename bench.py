@@ -179,6 +179,39 @@ def bench_hostfed(name, solver, batch_size, src_size, crop, classes, peak):
     return row
 
 
+def bench_transformer_lm(peak, seq_len=4096, batch=4, d_model=512,
+                         num_layers=6, num_heads=8, vocab=8192):
+    """Long-context row: causal transformer LM with the pallas flash
+    kernel (zoo.transformer_lm) — the workload the reference never had."""
+    import jax.numpy as jnp
+    from sparknet_tpu.models import zoo
+    solver = _mk_solver(zoo.transformer_lm(
+        vocab_size=vocab, seq_len=seq_len, batch_size=batch,
+        d_model=d_model, num_layers=num_layers, num_heads=num_heads,
+        flash=True))
+    rs = np.random.RandomState(0)
+    toks = rs.randint(0, vocab, (batch, seq_len))
+    batch_d = {"data": jnp.asarray(toks, jnp.int32),
+               "label": jnp.asarray((toks + 1) % vocab, jnp.int32)}
+    for _ in range(WARMUP):
+        loss = solver.train_step(batch_d)
+    float(loss)
+    dt = _time_windows(lambda: solver.train_step(batch_d), float)
+    tok_s = batch * seq_len * ITERS / dt
+    # analytic train FLOPs/token: 12*d^2 dense MACs/layer + causal
+    # attention S*d MACs/layer + d*vocab head MACs, x2 FLOP x3 train
+    flops = 3 * 2 * (num_layers * (12 * d_model ** 2 + seq_len * d_model)
+                     + d_model * vocab)
+    row = {"model": "transformer_lm", "mode": "synthetic",
+           "batch": batch, "seq_len": seq_len,
+           "tokens_per_sec": round(tok_s, 1),
+           "train_kflops_per_token": round(flops / 1e3, 1),
+           "model_tflops_per_sec": round(tok_s * flops / 1e12, 2)}
+    if peak:
+        row["mfu"] = round(tok_s * flops / peak, 4)
+    return row
+
+
 def main():
     import jax
     from sparknet_tpu.models import zoo
@@ -222,6 +255,12 @@ def main():
         128, (3, 224, 224), 1000, peak)
     rows.append(rowg)
     del sg
+
+    # long-context: flash-attention transformer LM at S=4096
+    try:
+        rows.append(bench_transformer_lm(peak))
+    except Exception as e:                  # keep the headline rows alive
+        print(f"#BENCH-SKIP transformer_lm: {e}", file=sys.stderr)
 
     head_out = {
         "metric": "caffenet_train_throughput",

@@ -61,12 +61,14 @@ def PoolingLayer(name, bottoms, pooling, kernel, stride, pad=None):
 
 
 def InnerProductLayer(name, bottoms, num_output, weight_filler=None,
-                      bias_filler=None, param=None):
+                      bias_filler=None, param=None, axis=None):
     ip = dict(num_output=num_output)
     if weight_filler is not None:
         ip["weight_filler"] = weight_filler
     if bias_filler is not None:
         ip["bias_filler"] = bias_filler
+    if axis is not None:
+        ip["axis"] = axis
     lp = _base("InnerProduct", name, bottoms, inner_product_param=ip)
     for p in (param or []):
         lp.add("param", **p)
@@ -77,8 +79,11 @@ def ReLULayer(name, bottoms, tops=None):
     return _base("ReLU", name, bottoms, tops=tops)
 
 
-def SoftmaxWithLoss(name, bottoms):
-    return _base("SoftmaxWithLoss", name, bottoms)
+def SoftmaxWithLoss(name, bottoms, axis=None):
+    kw = {}
+    if axis is not None:
+        kw["softmax_param"] = dict(axis=axis)
+    return _base("SoftmaxWithLoss", name, bottoms, **kw)
 
 
 def AccuracyLayer(name, bottoms, top_k=1, include=TEST):
@@ -126,6 +131,33 @@ def AttentionLayer(name, bottoms, num_heads, head_dim=None, causal=False,
     if head_dim is not None:
         ap["head_dim"] = head_dim
     return _base("Attention", name, bottoms, attention_param=ap)
+
+
+def EmbedLayer(name, bottoms, input_dim, num_output, weight_filler=None):
+    ep = dict(input_dim=input_dim, num_output=num_output)
+    if weight_filler is not None:
+        ep["weight_filler"] = weight_filler
+    return _base("Embed", name, bottoms, embed_param=ep)
+
+
+def PositionalEmbedLayer(name, bottoms, max_positions, num_output,
+                         weight_filler=None, tops=None):
+    """sparknet_tpu extension: learned positional table added in place."""
+    ep = dict(input_dim=max_positions, num_output=num_output)
+    if weight_filler is not None:
+        ep["weight_filler"] = weight_filler
+    return _base("PositionalEmbed", name, bottoms, tops=tops, embed_param=ep)
+
+
+def LayerNormLayer(name, bottoms, tops=None, eps=None, affine=None):
+    """sparknet_tpu extension: last-axis layer norm (transformer blocks)."""
+    ln = {}
+    if eps is not None:
+        ln["eps"] = eps
+    if affine is not None:
+        ln["affine"] = affine
+    return _base("LayerNorm", name, bottoms, tops=tops,
+                 layer_norm_param=ln or None)
 
 
 def NetParam(name, *layers):

@@ -7,7 +7,9 @@ self-contained — no prototxt files needed (though stock ones load too).
 
 from .dsl import (NetParam, RDDLayer, ConvolutionLayer, PoolingLayer,
                   InnerProductLayer, ReLULayer, SoftmaxWithLoss,
-                  AccuracyLayer, LRNLayer, DropoutLayer, ConcatLayer)
+                  AccuracyLayer, LRNLayer, DropoutLayer, ConcatLayer,
+                  EltwiseLayer, AttentionLayer, EmbedLayer,
+                  PositionalEmbedLayer, LayerNormLayer)
 
 
 def _conv(name, bottom, num_output, kernel, stride=1, pad=0, group=None,
@@ -256,3 +258,57 @@ def googlenet(batch_size=32, num_classes=1000, with_data=True,
     layers.append(loss)
     layers.append(AccuracyLayer("loss3/top-1", ["loss3/classifier", "label"]))
     return NetParam("GoogleNet", *layers)
+
+
+def transformer_lm(vocab_size=512, seq_len=256, batch_size=8, d_model=256,
+                   num_layers=4, num_heads=8, d_ff=None, max_positions=None,
+                   flash=True, ring=False, with_data=True):
+    """Decoder-only causal transformer LM — the long-context model family.
+
+    No CNN-era reference twin (SURVEY.md section 5: the reference has no
+    attention); this is the workload the framework's sequence machinery
+    exists for: the Attention layer dispatches to the pallas flash kernel
+    per chip (``flash=True``) or ring attention across a "seq" mesh axis
+    (``ring=True``), and pre-LN blocks keep bf16 activations stable.
+
+    Blobs: "data" (B, S) int32 token ids, "label" (B, S) int32 next-token
+    ids. Loss is mean cross-entropy per token (SoftmaxWithLoss axis=2).
+    """
+    d_ff = d_ff or 4 * d_model
+    max_positions = max_positions or seq_len
+    xavier = dict(type="xavier")
+    layers = []
+    if with_data:
+        layers += [RDDLayer("data", [batch_size, seq_len]),
+                   RDDLayer("label", [batch_size, seq_len])]
+    layers += [
+        EmbedLayer("tok_embed", ["data"], vocab_size, d_model,
+                   weight_filler=xavier),
+        PositionalEmbedLayer("pos_embed", ["tok_embed"], max_positions,
+                             d_model, weight_filler=xavier,
+                             tops=["embed"]),
+    ]
+    x = "embed"
+    for i in range(num_layers):
+        p = f"block{i}"
+        layers += [
+            LayerNormLayer(f"{p}/ln1", [x]),
+            AttentionLayer(f"{p}/attn", [f"{p}/ln1"], num_heads,
+                           causal=True, flash=flash, ring=ring),
+            EltwiseLayer(f"{p}/res1", [x, f"{p}/attn"]),
+            LayerNormLayer(f"{p}/ln2", [f"{p}/res1"]),
+            InnerProductLayer(f"{p}/ffn1", [f"{p}/ln2"], d_ff,
+                              weight_filler=xavier, axis=2),
+            ReLULayer(f"{p}/relu", [f"{p}/ffn1"], tops=[f"{p}/ffn1"]),
+            InnerProductLayer(f"{p}/ffn2", [f"{p}/ffn1"], d_model,
+                              weight_filler=xavier, axis=2),
+            EltwiseLayer(f"{p}/res2", [f"{p}/res1", f"{p}/ffn2"]),
+        ]
+        x = f"{p}/res2"
+    layers += [
+        LayerNormLayer("ln_f", [x]),
+        InnerProductLayer("lm_head", ["ln_f"], vocab_size,
+                          weight_filler=xavier, axis=2),
+        SoftmaxWithLoss("loss", ["lm_head", "label"], axis=2),
+    ]
+    return NetParam("TransformerLM", *layers)

@@ -75,3 +75,52 @@ class Embed(Layer):
         if self.bias_term:
             y = y + params[1]
         return [y]
+
+
+@register
+class PositionalEmbed(Layer):
+    """sparknet_tpu extension: adds a learned (max_positions, E) table to a
+    (B, S, E) activation — the positional half of a transformer's input
+    embedding. Reuses embed_param: input_dim = max positions (must be >= S),
+    num_output = E."""
+
+    type_name = "PositionalEmbed"
+
+    def __init__(self, lp, bottom_shapes, phase):
+        super().__init__(lp, bottom_shapes, phase)
+        p = lp.embed_param
+        self.p = p
+        b, s, e = bottom_shapes[0]
+        self.max_positions = int(p.input_dim)
+        if self.max_positions < s:
+            raise ValueError(
+                f"{lp.name}: embed_param.input_dim {self.max_positions} < "
+                f"sequence length {s}")
+        if int(p.num_output) != e:
+            raise ValueError(
+                f"{lp.name}: embed_param.num_output {p.num_output} != "
+                f"embedding dim {e}")
+        self.dim = int(e)
+
+    def param_shapes(self):
+        mults = _param_mults(self.lp, 1)
+        return [((self.max_positions, self.dim), self.p.weight_filler,
+                 *mults[0])]
+
+    def out_shapes(self):
+        return [tuple(self.bottom_shapes[0])]
+
+    def apply(self, params, bottoms, train, rng):
+        import jax.lax as lax
+        from ..parallel import context
+        x = bottoms[0]
+        s = x.shape[1]
+        seq_axis = context.axis("seq")
+        if seq_axis is not None:
+            # sequence-sharded (ring/Ulysses): this shard holds global
+            # positions [idx*s, (idx+1)*s), not [0, s)
+            start = lax.axis_index(seq_axis) * s
+            rows = lax.dynamic_slice_in_dim(params[0], start, s, 0)
+        else:
+            rows = params[0][:s]
+        return [x + rows.astype(x.dtype)[None]]

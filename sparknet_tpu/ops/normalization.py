@@ -1,4 +1,4 @@
-"""Normalization layers: BatchNorm (stateful) and MVN.
+"""Normalization layers: BatchNorm (stateful), MVN, and LayerNorm.
 
 BatchNorm matches reference batch_norm_layer.cpp: three non-learnable blobs
 [running_mean*s, running_var*s, s] where s is the accumulated scale factor;
@@ -10,6 +10,11 @@ than mutated in place.
 
 MVN (mvn_layer.cpp) normalizes each sample (per channel, or across channels)
 to zero mean and, optionally, unit variance with divisor (std + eps).
+
+LayerNorm is a sparknet_tpu extension (no CNN-era reference twin): last-axis
+normalization with learned gamma/beta, the transformer-block complement of
+the Attention layer. Statistics in fp32 regardless of activation dtype (the
+bf16 mixed-precision path keeps reductions exact).
 """
 
 import numpy as np
@@ -70,6 +75,42 @@ def _bcast(v, x):
     shape = [1] * x.ndim
     shape[1] = v.shape[0]
     return v.reshape(shape)
+
+
+@register
+class LayerNorm(Layer):
+    type_name = "LayerNorm"
+
+    def __init__(self, lp, bottom_shapes, phase):
+        super().__init__(lp, bottom_shapes, phase)
+        p = lp.layer_norm_param
+        self.eps = float(p.eps)
+        self.affine = bool(int(p.affine))
+        self.dim = int(bottom_shapes[0][-1])
+
+    def param_shapes(self):
+        if not self.affine:
+            return []
+        from ..proto import Message
+        from .convolution import _param_mults
+        mults = _param_mults(self.lp, 2)
+        ones = Message("FillerParameter", type="constant", value=1.0)
+        return [((self.dim,), ones, *mults[0]),          # gamma
+                ((self.dim,), None, *mults[1])]          # beta (zeros)
+
+    def out_shapes(self):
+        return [self.bottom_shapes[0]]
+
+    def apply(self, params, bottoms, train, rng):
+        x = bottoms[0]
+        xf = x.astype(jnp.float32)
+        mean = jnp.mean(xf, axis=-1, keepdims=True)
+        var = jnp.mean((xf - mean) ** 2, axis=-1, keepdims=True)
+        y = (xf - mean) / jnp.sqrt(var + self.eps)
+        if self.affine:
+            y = y * params[0].astype(jnp.float32) \
+                + params[1].astype(jnp.float32)
+        return [y.astype(x.dtype)]
 
 
 @register

@@ -97,6 +97,12 @@ def _fit_block(block, s):
             if d % 8 == 0:
                 return d
             largest = max(largest, d)
+    if largest < 8 and s > 64:
+        # e.g. prime S: the only divisors are 1/S — a 1-row block means
+        # S^2 sequential kernel dispatches (near-hang), worse than failing
+        raise ValueError(
+            f"sequence {s} has no usable flash block divisor "
+            f"<= {block}; pad the sequence to a multiple of 128")
     return largest
 
 

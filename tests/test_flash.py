@@ -81,10 +81,14 @@ def test_flash_bf16_inputs():
 
 
 def test_flash_fits_blocks_to_indivisible_sequence():
-    """Requested blocks that don't divide S auto-shrink (halving) instead
-    of raising; the result stays exact."""
+    """Requested blocks that don't divide S auto-shrink to the largest
+    (multiple-of-8) divisor; the result stays exact. Sequences with no
+    usable divisor (e.g. prime) raise instead of near-hanging."""
     q, k, v = _rand_qkv(1, 1, 96, 32)
-    out = flash_attention(q, k, v, False, None, 64, 64)   # 96 % 64 -> 32
+    out = flash_attention(q, k, v, False, None, 64, 64)   # 96 % 64 -> 48
     want = dense_attention(q, k, v, causal=False)
     np.testing.assert_allclose(np.asarray(out), np.asarray(want),
                                atol=2e-5, rtol=2e-5)
+    q, k, v = _rand_qkv(1, 1, 1031, 8)    # prime S > max block
+    with pytest.raises(ValueError, match="usable flash block"):
+        flash_attention(q, k, v, False)

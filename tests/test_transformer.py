@@ -1,0 +1,162 @@
+"""Transformer-LM model family: LayerNorm/PositionalEmbed layers and the
+zoo.transformer_lm builder (the long-context workload the Attention/flash/
+ring machinery exists for — no CNN-era reference twin, SURVEY.md section 5)."""
+
+import math
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from sparknet_tpu.proto import Message
+from sparknet_tpu.models import zoo
+from sparknet_tpu.graph.compiler import CompiledNet, TRAIN
+
+from test_layers import make_layer, check_grad
+
+
+# ------------------------------------------------------------- layers ----
+
+class TestLayerNorm:
+    def test_forward_normalizes_last_axis(self):
+        layer, _ = make_layer("LayerNorm", [(2, 3, 8)])
+        x = jnp.asarray(np.random.RandomState(0).randn(2, 3, 8) * 3 + 5,
+                        jnp.float32)
+        gamma, beta = jnp.ones(8), jnp.zeros(8)
+        (y,) = layer.apply([gamma, beta], [x], True, None)
+        np.testing.assert_allclose(np.asarray(y.mean(-1)), 0, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(y.std(-1)), 1, atol=1e-3)
+
+    def test_affine_params_and_defaults(self):
+        layer, _ = make_layer("LayerNorm", [(2, 4)])
+        shapes = layer.param_shapes()
+        assert [s[0] for s in shapes] == [(4,), (4,)]
+        # gamma filler is constant-1 (not Caffe's constant-0 default)
+        assert shapes[0][1].type == "constant" and shapes[0][1].value == 1.0
+        off, _ = make_layer("LayerNorm", [(2, 4)],
+                            layer_norm_param=dict(affine=False))
+        assert off.param_shapes() == []
+
+    def test_gradcheck(self):
+        layer, _ = make_layer("LayerNorm", [(2, 6)])
+        gamma = jnp.asarray(np.random.RandomState(1).rand(6) + 0.5,
+                            jnp.float32)
+        beta = jnp.asarray(np.random.RandomState(2).randn(6), jnp.float32)
+        x0 = np.random.RandomState(3).randn(2, 6)
+
+        def f(x):
+            (y,) = layer.apply([gamma, beta], [x], True, None)
+            return jnp.sum(y * jnp.arange(y.size).reshape(y.shape))
+
+        check_grad(f, x0, step=1e-3)
+
+
+class TestPositionalEmbed:
+    def test_adds_table_prefix(self):
+        layer, _ = make_layer("PositionalEmbed", [(2, 3, 4)],
+                              embed_param=dict(input_dim=8, num_output=4))
+        x = jnp.zeros((2, 3, 4))
+        table = jnp.asarray(np.arange(32).reshape(8, 4), jnp.float32)
+        (y,) = layer.apply([table], [x], True, None)
+        np.testing.assert_array_equal(np.asarray(y[0]),
+                                      np.asarray(table[:3]))
+        np.testing.assert_array_equal(np.asarray(y[0]), np.asarray(y[1]))
+
+    def test_sequence_sharded_uses_global_positions(self):
+        """Under a "seq" mesh each shard must add ITS slice of the table
+        (global positions), not rows 0..S_local-1."""
+        from sparknet_tpu.parallel import make_mesh, sequence_sharded_apply
+        layer, _ = make_layer("PositionalEmbed", [(1, 8, 4)],
+                              embed_param=dict(input_dim=64, num_output=4))
+        table = jnp.asarray(np.arange(256).reshape(64, 4), jnp.float32)
+        x = jnp.zeros((1, 64, 4))
+        (want,) = make_layer(
+            "PositionalEmbed", [(1, 64, 4)],
+            embed_param=dict(input_dim=64, num_output=4),
+        )[0].apply([table], [x], True, None)
+
+        mesh = make_mesh({"seq": 8})
+
+        def fwd(xs):
+            (y,) = layer.apply([table], [xs], True, None)
+            return y
+
+        out = sequence_sharded_apply(fwd, mesh, seq_dim=1)(x)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(want))
+
+    def test_rejects_short_table_or_wrong_dim(self):
+        with pytest.raises(ValueError, match="input_dim"):
+            make_layer("PositionalEmbed", [(2, 9, 4)],
+                       embed_param=dict(input_dim=8, num_output=4))
+        with pytest.raises(ValueError, match="num_output"):
+            make_layer("PositionalEmbed", [(2, 3, 4)],
+                       embed_param=dict(input_dim=8, num_output=5))
+
+
+# ------------------------------------------------------------- the LM ----
+
+def _tiny_lm(**kw):
+    cfg = dict(vocab_size=32, seq_len=16, batch_size=2, d_model=32,
+               num_layers=2, num_heads=4, flash=False)
+    cfg.update(kw)
+    return zoo.transformer_lm(**cfg)
+
+
+def test_lm_init_loss_near_uniform():
+    net = CompiledNet(_tiny_lm(), TRAIN)
+    params, state = net.init(jax.random.PRNGKey(0))
+    rs = np.random.RandomState(0)
+    batch = {"data": rs.randint(0, 32, (2, 16)),
+             "label": rs.randint(0, 32, (2, 16))}
+    loss, _ = net.loss_fn(params, state, batch, jax.random.PRNGKey(1))
+    assert abs(float(loss) - math.log(32)) < 0.8
+
+
+def test_lm_causality():
+    """Changing token t must not change any logit before t."""
+    net = CompiledNet(_tiny_lm(num_layers=1, batch_size=1), TRAIN)
+    params, state = net.init(jax.random.PRNGKey(0))
+    rs = np.random.RandomState(0)
+    toks = rs.randint(0, 32, (1, 16))
+    toks2 = toks.copy()
+    toks2[0, 10] = (toks2[0, 10] + 1) % 32
+    lab = rs.randint(0, 32, (1, 16))
+
+    def logits(t):
+        blobs, _ = net.apply(params, state, {"data": t, "label": lab},
+                             train=False)
+        return np.asarray(blobs["lm_head"])
+
+    a, b = logits(toks), logits(toks2)
+    np.testing.assert_allclose(a[0, :10], b[0, :10], atol=1e-5)
+    assert np.abs(a[0, 10:] - b[0, 10:]).max() > 1e-4
+
+
+def test_lm_learns_constant_next_token():
+    """Ten SGD steps on a deterministic next-token rule drop the loss."""
+    from sparknet_tpu.solver.solver import Solver
+    sp = Message("SolverParameter", base_lr=0.1, lr_policy="fixed",
+                 momentum=0.9, display=0, random_seed=0)
+    solver = Solver(sp, net_param=_tiny_lm())
+    rs = np.random.RandomState(0)
+    toks = rs.randint(0, 32, (2, 16))
+    batch = {"data": toks, "label": (toks + 1) % 32}   # label = succ(token)
+    first = float(solver.train_step(batch))
+    for _ in range(10):
+        last = float(solver.train_step(batch))
+    assert last < first - 1.0
+
+
+def test_lm_flash_matches_dense():
+    """flash=True and flash=False produce the same forward on the same
+    params (S multiple of 128 so the pallas path engages in interpret)."""
+    net_d = CompiledNet(_tiny_lm(seq_len=128, flash=False), TRAIN)
+    net_f = CompiledNet(_tiny_lm(seq_len=128, flash=True), TRAIN)
+    params, state = net_d.init(jax.random.PRNGKey(0))
+    rs = np.random.RandomState(0)
+    batch = {"data": rs.randint(0, 32, (2, 128)),
+             "label": rs.randint(0, 32, (2, 128))}
+    la, _ = net_d.loss_fn(params, state, batch, jax.random.PRNGKey(1))
+    lb, _ = net_f.loss_fn(params, state, batch, jax.random.PRNGKey(1))
+    assert abs(float(la) - float(lb)) < 1e-3
