@@ -230,52 +230,64 @@ def main():
                  if k.lower() in dev.device_kind.lower()), None)
     rows = []
 
-    # headline: CaffeNet batch 256, synthetic-fed (the reference workload)
+    def emit(row):
+        # stream rows as they finish: a killed/timed-out run still leaves
+        # every completed measurement on stderr and in bench_details.json
+        rows.append(row)
+        print("#BENCH " + json.dumps(row), file=sys.stderr, flush=True)
+        with open("bench_details.json", "w") as f:
+            json.dump({"device": dev.device_kind, "platform": dev.platform,
+                       "peak_bf16_flops": peak, "rows": rows}, f, indent=1)
+
+    # headline: CaffeNet batch 256, synthetic-fed (the reference workload).
+    # The driver's ONE JSON line prints immediately — supplementary rows
+    # below must not be able to take it down with them.
     head, solver = bench_synthetic(
         "caffenet", zoo.caffenet(batch_size=256, num_classes=1000),
         256, (3, 227, 227), 1000, peak)
-    rows.append(head)
-
-    # honest row: same model+batch fed from uint8 host data via the
-    # native transform + prefetch pipeline
-    rows.append(bench_hostfed("caffenet", solver, 256, 256, 227, 1000,
-                              peak))
-    del solver
-
-    # batch-512 variant: bigger MXU tiles amortize the small spatial dims
-    row512, s512 = bench_synthetic(
-        "caffenet", zoo.caffenet(batch_size=512, num_classes=1000),
-        512, (3, 227, 227), 1000, peak)
-    rows.append(row512)
-    del s512
-
-    # GoogLeNet (the reference's third headline model family)
-    rowg, sg = bench_synthetic(
-        "googlenet", zoo.googlenet(batch_size=128, num_classes=1000),
-        128, (3, 224, 224), 1000, peak)
-    rows.append(rowg)
-    del sg
-
-    # long-context: flash-attention transformer LM at S=4096
-    try:
-        rows.append(bench_transformer_lm(peak))
-    except Exception as e:                  # keep the headline rows alive
-        print(f"#BENCH-SKIP transformer_lm: {e}", file=sys.stderr)
-
-    head_out = {
+    print(json.dumps({
         "metric": "caffenet_train_throughput",
         "value": head["images_per_sec"],
         "unit": "images/sec",
         "vs_baseline": round(head["images_per_sec"] / BASELINE_IMG_PER_SEC,
                              3),
-    }
-    print(json.dumps(head_out))
-    detail = {"device": dev.device_kind, "platform": dev.platform,
-              "peak_bf16_flops": peak, "rows": rows}
-    for r in rows:
-        print("#BENCH " + json.dumps(r), file=sys.stderr)
-    with open("bench_details.json", "w") as f:
-        json.dump(detail, f, indent=1)
+    }), flush=True)
+    emit(head)
+
+    # honest row: same model+batch fed from uint8 host data via the
+    # native transform + prefetch pipeline
+    try:
+        emit(bench_hostfed("caffenet", solver, 256, 256, 227, 1000, peak))
+    except Exception as e:
+        print(f"#BENCH-SKIP host_fed: {e}", file=sys.stderr, flush=True)
+    del solver
+
+    # batch-512 variant: bigger MXU tiles amortize the small spatial dims
+    try:
+        row512, s512 = bench_synthetic(
+            "caffenet", zoo.caffenet(batch_size=512, num_classes=1000),
+            512, (3, 227, 227), 1000, peak)
+        emit(row512)
+        del s512
+    except Exception as e:
+        print(f"#BENCH-SKIP caffenet_b512: {e}", file=sys.stderr, flush=True)
+
+    # GoogLeNet (the reference's third headline model family)
+    try:
+        rowg, sg = bench_synthetic(
+            "googlenet", zoo.googlenet(batch_size=128, num_classes=1000),
+            128, (3, 224, 224), 1000, peak)
+        emit(rowg)
+        del sg
+    except Exception as e:
+        print(f"#BENCH-SKIP googlenet: {e}", file=sys.stderr, flush=True)
+
+    # long-context: flash-attention transformer LM at S=4096
+    try:
+        emit(bench_transformer_lm(peak))
+    except Exception as e:                  # keep the headline rows alive
+        print(f"#BENCH-SKIP transformer_lm: {e}", file=sys.stderr,
+              flush=True)
 
 
 if __name__ == "__main__":

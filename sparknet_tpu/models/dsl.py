@@ -149,6 +149,25 @@ def PositionalEmbedLayer(name, bottoms, max_positions, num_output,
     return _base("PositionalEmbed", name, bottoms, tops=tops, embed_param=ep)
 
 
+def MoELayer(name, bottoms, num_experts, hidden_dim=None,
+             capacity_factor=None, expert_parallel=False,
+             aux_loss_weight=None, weight_filler=None):
+    """sparknet_tpu extension: Switch-style MoE FFN. aux_loss_weight adds a
+    second top carrying the load-balancing loss with that loss_weight."""
+    mp = dict(num_experts=num_experts, expert_parallel=expert_parallel)
+    if hidden_dim is not None:
+        mp["hidden_dim"] = hidden_dim
+    if capacity_factor is not None:
+        mp["capacity_factor"] = capacity_factor
+    if weight_filler is not None:
+        mp["weight_filler"] = weight_filler
+    tops = [name] if aux_loss_weight is None else [name, f"{name}_aux"]
+    lp = _base("MoE", name, bottoms, tops=tops, moe_param=mp)
+    if aux_loss_weight is not None:
+        lp.loss_weight.extend([0.0, float(aux_loss_weight)])
+    return lp
+
+
 def LayerNormLayer(name, bottoms, tops=None, eps=None, affine=None):
     """sparknet_tpu extension: last-axis layer norm (transformer blocks)."""
     ln = {}

@@ -1,0 +1,150 @@
+"""Switch-style mixture-of-experts FFN with expert parallelism.
+
+sparknet_tpu extension (no reference twin — SURVEY.md section 2c lists
+EP/MoE as absent from the CNN-era reference); the expert-parallel half of
+the framework's distributed story, alongside dp (pmean), tp (gspmd) and
+sp (ring/Ulysses).
+
+Routing is top-1 (Switch Transformer) with a capacity limit: each token
+goes to its argmax expert; an expert accepts at most
+C = ceil(tokens/num_experts * capacity_factor) tokens and overflow tokens
+pass through as zeros (the surrounding residual connection carries them).
+Tops: [output] or [output, aux] where aux is the Switch load-balancing
+loss (num_experts * sum_e fraction_e * mean_gate_e) — give the second top
+a loss_weight to train against expert collapse.
+
+Expert parallelism: under a mesh axis named "expert" (published via
+parallel.context, like "seq" for ring attention) and
+moe_param.expert_parallel, the (num_experts, capacity, embed) dispatch
+buffer is exchanged with ONE tiled all_to_all so each device runs only
+its own num_experts/ep_size experts, then a second all_to_all returns
+expert outputs to their source tokens. Dispatch/combine are sort-based
+scatter/gather (O(n log n + n*C), not an O(n^2) one-hot mask) and run
+identically on 1 device and on an N-way expert mesh, so the two paths
+agree exactly (tested).
+
+Weight blobs (expert-major so a GSPMD param_rule or shard_map in_spec can
+shard dim 0 across the expert axis):
+  router (num_experts, E) | w1 (num_experts, F, E) | b1 (num_experts, F)
+  | w2 (num_experts, E, F) | b2 (num_experts, E)
+"""
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..proto import Message
+from ..graph.registry import Layer, register
+from ..parallel import context
+from .convolution import _param_mults
+
+
+@register
+class MoE(Layer):
+    type_name = "MoE"
+
+    def __init__(self, lp, bottom_shapes, phase):
+        super().__init__(lp, bottom_shapes, phase)
+        p = lp.moe_param
+        self.p = p
+        b, s, e = bottom_shapes[0]
+        self.embed = int(e)
+        self.num_experts = int(p.num_experts)
+        if self.num_experts < 2:
+            raise ValueError(f"{lp.name}: moe_param.num_experts must be >= 2")
+        self.hidden = int(p.hidden_dim) or 4 * self.embed
+        self.capacity_factor = float(p.capacity_factor)
+        self.expert_parallel = bool(int(p.expert_parallel))
+
+    def _capacity(self, n):
+        return max(1, math.ceil(n / self.num_experts * self.capacity_factor))
+
+    def param_shapes(self):
+        mults = _param_mults(self.lp, 5)
+        X, E, F = self.num_experts, self.embed, self.hidden
+
+        def xavier(fan_in):
+            # explicit uniform(+-sqrt(3/fan)) — the generic xavier filler
+            # would read fan_in off the FULL 3-d blob shape (F*E), not the
+            # per-expert matmul contraction, under-scaling by sqrt(F)
+            lim = math.sqrt(3.0 / fan_in)
+            return Message("FillerParameter", type="uniform",
+                           min=-lim, max=lim)
+
+        wf = self.p.weight_filler if self.p.has("weight_filler") else None
+        return [((X, E), wf or xavier(E), *mults[0]),       # router
+                ((X, F, E), wf or xavier(E), *mults[1]),    # w1
+                ((X, F), None, *mults[2]),                  # b1
+                ((X, E, F), wf or xavier(F), *mults[3]),    # w2
+                ((X, E), None, *mults[4])]                  # b2
+
+    def out_shapes(self):
+        shapes = [tuple(self.bottom_shapes[0])]
+        if len(self.lp.top) > 1:
+            shapes.append(())                     # aux load-balancing loss
+        return shapes
+
+    def apply(self, params, bottoms, train, rng):
+        x = bottoms[0]
+        router, w1, b1, w2, b2 = params
+        b, s, e = x.shape
+        n = b * s
+        X = self.num_experts
+        xt = x.reshape(n, e)
+
+        logits = xt.astype(jnp.float32) @ router.T.astype(jnp.float32)
+        gates = jax.nn.softmax(logits, axis=-1)            # (n, X)
+        idx = jnp.argmax(gates, axis=-1)                   # (n,)
+        gate = jnp.take_along_axis(gates, idx[:, None], 1)[:, 0]
+
+        # sort-based dispatch, O(n log n + n*C*e) — a dense (n, X, C)
+        # one-hot mask would be O(n^2) at long-context token counts.
+        # Stable sort by expert; rank within expert = position - first
+        # occurrence; earlier tokens win capacity slots (same priority rule
+        # as the reference Switch implementation's cumsum).
+        C = self._capacity(n)
+        order = jnp.argsort(idx, stable=True)              # (n,)
+        idx_sorted = idx[order]
+        starts = jnp.searchsorted(idx_sorted, jnp.arange(X))
+        rank = jnp.arange(n) - starts[idx_sorted]
+        keep_s = rank < C
+        # dropped/overflow tokens route to a trash row past the buffer
+        dest = jnp.where(keep_s, idx_sorted * C + rank, X * C)
+        buf = jnp.zeros((X * C + 1, e), jnp.float32) \
+            .at[dest].set(xt[order].astype(jnp.float32))
+        xe = buf[:-1].reshape(X, C, e)
+
+        ep_axis = context.axis("expert") if self.expert_parallel else None
+        if ep_axis is not None:
+            # (X, C, e): split expert-major across the mesh, gather every
+            # peer's tokens for OUR experts along the capacity axis
+            xe = lax.all_to_all(xe, ep_axis, split_axis=0, concat_axis=1,
+                                tiled=True)                # (X/ep, ep*C, e)
+
+        w1l, b1l, w2l, b2l = (w.astype(jnp.float32)
+                              for w in (w1, b1, w2, b2))
+        h = jax.nn.relu(jnp.einsum("xce,xfe->xcf", xe, w1l)
+                        + b1l[:, None, :])
+        ye = jnp.einsum("xcf,xef->xce", h, w2l) + b2l[:, None, :]
+
+        if ep_axis is not None:
+            ye = lax.all_to_all(ye, ep_axis, split_axis=1, concat_axis=0,
+                                tiled=True)                # (X, C, e)
+
+        # combine: gather each token's expert output back (dropped tokens
+        # hit the zero trash row), weight by its gate
+        inv = jnp.argsort(order, stable=True)              # token -> sorted pos
+        token_slot = dest[inv]                             # (n,)
+        padded = jnp.concatenate(
+            [ye.reshape(X * C, e), jnp.zeros((1, e), jnp.float32)])
+        y = padded[token_slot] * gate[:, None]
+        tops = [y.reshape(b, s, e).astype(x.dtype)]
+        if len(self.lp.top) > 1:
+            # Switch aux loss: X * sum_e (token fraction)*(mean gate)
+            frac = jnp.mean(jax.nn.one_hot(idx, X, dtype=jnp.float32),
+                            axis=0)
+            tops.append(jnp.asarray(X, jnp.float32)
+                        * jnp.sum(frac * jnp.mean(gates, axis=0)))
+        return tops
