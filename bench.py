@@ -233,11 +233,14 @@ def main():
     def emit(row):
         # stream rows as they finish: a killed/timed-out run still leaves
         # every completed measurement on stderr and in bench_details.json
+        # (written atomically so a mid-write kill can't truncate it)
+        import os
         rows.append(row)
         print("#BENCH " + json.dumps(row), file=sys.stderr, flush=True)
-        with open("bench_details.json", "w") as f:
+        with open("bench_details.json.tmp", "w") as f:
             json.dump({"device": dev.device_kind, "platform": dev.platform,
                        "peak_bf16_flops": peak, "rows": rows}, f, indent=1)
+        os.replace("bench_details.json.tmp", "bench_details.json")
 
     # headline: CaffeNet batch 256, synthetic-fed (the reference workload).
     # The driver's ONE JSON line prints immediately — supplementary rows

@@ -13,7 +13,11 @@ collectives over a named device mesh:
 
 Long-context sequence parallelism (absent in the CNN-era reference but
 first-class here) lives in `ring`: ring attention via ppermute and
-Ulysses-style all-to-all head/sequence resharding.
+Ulysses-style all-to-all head/sequence resharding. `gspmd` shards
+weights+optimizer state (tp/ZeRO-style), `ops.moe` adds expert
+parallelism over an "expert" axis, and `pipeline` adds GPipe microbatch
+pipelining over a "pipe" axis — the full dp/tp/sp/ep/pp set, each
+exercised by the driver's multichip dryrun.
 """
 
 import importlib
@@ -24,6 +28,7 @@ __all__ = [
     "DataParallelSolver", "LocalSGDSolver", "shard_batch",
     "GSPMDSolver", "default_param_rule",
     "ring_attention", "ulysses_attention", "sequence_sharded_apply",
+    "gpipe", "pipeline_apply", "stack_params",
 ]
 
 # lazy exports (PEP 562): ops.attention imports parallel.{context,ring} while
@@ -38,6 +43,8 @@ _EXPORTS = {
     "GSPMDSolver": "gspmd", "default_param_rule": "gspmd",
     "ring_attention": "ring", "ulysses_attention": "ring",
     "sequence_sharded_apply": "ring",
+    "gpipe": "pipeline", "pipeline_apply": "pipeline",
+    "stack_params": "pipeline",
 }
 
 

@@ -1,0 +1,111 @@
+"""GPipe-style pipeline parallelism over a "pipe" mesh axis.
+
+sparknet_tpu extension (SURVEY.md section 2c: PP absent from the
+reference); completes the mesh-axis set next to dp (pmean), tp (gspmd),
+sp (ring/Ulysses) and ep (MoE all_to_all).
+
+The model's repeated trunk (e.g. transformer blocks) is expressed as ONE
+``block_fn(block_params, x) -> x`` applied L times with stacked params —
+leaves shaped (L, ...). Stages shard that stack over the "pipe" axis
+(leading dim, P("pipe")), so each device owns L/S consecutive blocks and
+applies them with an inner ``lax.scan``. The batch is split into M
+microbatches; the classic GPipe schedule runs M + S - 1 ticks, each tick
+being block_fn on every stage followed by one ``ppermute`` shifting
+activations to the next stage. Stage 0 injects microbatch t at tick t;
+the last stage collects microbatch t at tick t + S - 1; a final masked
+``psum`` replicates the collected outputs. Warm-up/drain ticks compute on
+zeros — their outputs are never collected and never contribute gradient,
+so autodiff through the scan + ppermute chain is exact (bubble cost
+(S-1)/(M+S-1) of compute, the GPipe trade).
+
+Embedding/head layers (stage-heterogeneous) stay OUTSIDE the pipeline:
+compute them replicated (or data-parallel) before/after ``pipeline_apply``
+— they are a tiny fraction of LM FLOPs.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+
+def gpipe(block_fn, local_params, microbatches, axis):
+    """The SPMD schedule; call INSIDE shard_map over ``axis``.
+
+    local_params: this stage's stacked block params, leaves (L_local, ...).
+    microbatches: (M, mb, ...) — full input, identical on every stage.
+    -> (M, mb, ...) outputs of the final stage, identical on every stage.
+    """
+    S = lax.psum(1, axis)
+    d = lax.axis_index(axis)
+    M = microbatches.shape[0]
+    T = M + S - 1
+
+    def stage(x):
+        def body(h, p):
+            return block_fn(p, h), None
+        out, _ = lax.scan(body, x, local_params)
+        return out
+
+    zero_mb = jnp.zeros_like(microbatches[0])
+    # pad the injection stream past M with zeros (drain ticks)
+    feed = jnp.concatenate(
+        [microbatches, jnp.zeros((S - 1,) + microbatches.shape[1:],
+                                 microbatches.dtype)]) if S > 1 \
+        else microbatches
+
+    def tick(carry, t):
+        state, out_buf = carry
+        x = jnp.where(d == 0, feed[t], state)
+        y = stage(x)
+        # last stage holds microbatch t-(S-1) at tick t
+        m = t - (S - 1)
+        valid = jnp.logical_and(d == S - 1,
+                                jnp.logical_and(m >= 0, m < M))
+        mi = jnp.clip(m, 0, M - 1)
+        out_buf = out_buf.at[mi].set(
+            jnp.where(valid, y, out_buf[mi]))
+        state = lax.ppermute(y, axis,
+                             [(i, (i + 1) % S) for i in range(S)])
+        return (state, out_buf), None
+
+    out0 = jnp.zeros_like(microbatches)
+    (_, out_buf), _ = lax.scan(tick, (zero_mb, out0), jnp.arange(T))
+    # replicate the last stage's collected outputs to every stage
+    return lax.psum(jnp.where(d == S - 1, out_buf, jnp.zeros_like(out_buf)),
+                    axis)
+
+
+def pipeline_apply(block_fn, stacked_params, x, mesh, num_microbatches,
+                   axis="pipe"):
+    """Run a stack of L identical blocks as an S-stage pipeline.
+
+    stacked_params: pytree, leaves (L, ...), L divisible by mesh axis size
+    (sharded P(axis) on dim 0 — each stage gets its consecutive blocks).
+    x: (B, ...) with B divisible by num_microbatches.
+    -> (B, ...) after all L blocks, bitwise-independent of S (tested).
+    """
+    B = x.shape[0]
+    M = num_microbatches
+    if B % M:
+        raise ValueError(f"batch {B} not divisible by microbatches {M}")
+    mb = x.reshape(M, B // M, *x.shape[1:])
+
+    def inner(params, xs):
+        return gpipe(block_fn, params, xs, axis)
+
+    pspec = jax.tree_util.tree_map(lambda _: P(axis), stacked_params)
+    out = jax.shard_map(
+        inner, mesh=mesh,
+        in_specs=(pspec, P()), out_specs=P(), check_vma=False,
+    )(stacked_params, mb)
+    return out.reshape(B, *x.shape[1:])
+
+
+def stack_params(per_block_params):
+    """[block0_pytree, block1_pytree, ...] (identical structures) ->
+    one pytree with leaves stacked on a new leading (L) dim."""
+    return jax.tree_util.tree_map(
+        lambda *leaves: jnp.stack(leaves), *per_block_params)
