@@ -9,7 +9,7 @@ from .dsl import (NetParam, RDDLayer, ConvolutionLayer, PoolingLayer,
                   InnerProductLayer, ReLULayer, SoftmaxWithLoss,
                   AccuracyLayer, LRNLayer, DropoutLayer, ConcatLayer,
                   EltwiseLayer, AttentionLayer, EmbedLayer,
-                  PositionalEmbedLayer, LayerNormLayer)
+                  PositionalEmbedLayer, LayerNormLayer, MoELayer)
 
 
 def _conv(name, bottom, num_output, kernel, stride=1, pad=0, group=None,
@@ -262,7 +262,8 @@ def googlenet(batch_size=32, num_classes=1000, with_data=True,
 
 def transformer_lm(vocab_size=512, seq_len=256, batch_size=8, d_model=256,
                    num_layers=4, num_heads=8, d_ff=None, max_positions=None,
-                   flash=True, ring=False, with_data=True):
+                   flash=True, ring=False, with_data=True, moe_experts=0,
+                   moe_aux_weight=0.01):
     """Decoder-only causal transformer LM — the long-context model family.
 
     No CNN-era reference twin (SURVEY.md section 5: the reference has no
@@ -270,6 +271,10 @@ def transformer_lm(vocab_size=512, seq_len=256, batch_size=8, d_model=256,
     exists for: the Attention layer dispatches to the pallas flash kernel
     per chip (``flash=True``) or ring attention across a "seq" mesh axis
     (``ring=True``), and pre-LN blocks keep bf16 activations stable.
+    ``moe_experts > 0`` replaces every block's dense FFN with a
+    Switch-MoE of that many experts (expert_parallel engages under an
+    "expert" mesh axis), adding the load-balancing aux loss with weight
+    ``moe_aux_weight``.
 
     Blobs: "data" (B, S) int32 token ids, "label" (B, S) int32 next-token
     ids. Loss is mean cross-entropy per token (SoftmaxWithLoss axis=2).
@@ -297,13 +302,23 @@ def transformer_lm(vocab_size=512, seq_len=256, batch_size=8, d_model=256,
                            causal=True, flash=flash, ring=ring),
             EltwiseLayer(f"{p}/res1", [x, f"{p}/attn"]),
             LayerNormLayer(f"{p}/ln2", [f"{p}/res1"]),
-            InnerProductLayer(f"{p}/ffn1", [f"{p}/ln2"], d_ff,
-                              weight_filler=xavier, axis=2),
-            ReLULayer(f"{p}/relu", [f"{p}/ffn1"], tops=[f"{p}/ffn1"]),
-            InnerProductLayer(f"{p}/ffn2", [f"{p}/ffn1"], d_model,
-                              weight_filler=xavier, axis=2),
-            EltwiseLayer(f"{p}/res2", [f"{p}/res1", f"{p}/ffn2"]),
         ]
+        if moe_experts:
+            layers += [
+                MoELayer(f"{p}/moe", [f"{p}/ln2"], moe_experts,
+                         hidden_dim=d_ff, expert_parallel=True,
+                         aux_loss_weight=moe_aux_weight),
+                EltwiseLayer(f"{p}/res2", [f"{p}/res1", f"{p}/moe"]),
+            ]
+        else:
+            layers += [
+                InnerProductLayer(f"{p}/ffn1", [f"{p}/ln2"], d_ff,
+                                  weight_filler=xavier, axis=2),
+                ReLULayer(f"{p}/relu", [f"{p}/ffn1"], tops=[f"{p}/ffn1"]),
+                InnerProductLayer(f"{p}/ffn2", [f"{p}/ffn1"], d_model,
+                                  weight_filler=xavier, axis=2),
+                EltwiseLayer(f"{p}/res2", [f"{p}/res1", f"{p}/ffn2"]),
+            ]
         x = f"{p}/res2"
     layers += [
         LayerNormLayer("ln_f", [x]),
