@@ -249,3 +249,35 @@ class TestV1Upgrade:
         assert up.layer[0].param[1].lr_mult == 2.0
         assert up.layer[0].param[1].decay_mult == 0.0
         assert not up.layers
+
+
+# every remaining stock net prototxt in the reference tree compiles AND
+# runs one forward (the "a reference user finds everything they need" bar;
+# quick/full/caffenet/googlenet/lenet_train_test are covered above)
+_STOCK_NETS = [
+    ("examples/cifar10/cifar10_full_sigmoid_train_test.prototxt",
+     (2, 3, 32, 32)),
+    ("examples/cifar10/cifar10_full_sigmoid_train_test_bn.prototxt",
+     (2, 3, 32, 32)),
+    ("models/bvlc_alexnet/train_val.prototxt", (2, 3, 227, 227)),
+    ("models/finetune_flickr_style/train_val.prototxt", (2, 3, 227, 227)),
+    ("examples/mnist/lenet.prototxt", None),   # deploy net: `input` blobs
+]
+
+
+@pytest.mark.parametrize("rel,shape", _STOCK_NETS,
+                         ids=[r.split("/")[-1] for r, _ in _STOCK_NETS])
+def test_stock_net_compiles_and_forwards(rel, shape):
+    npm = proto.load_prototxt(f"{REF}/{rel}", "NetParameter")
+    feed = {"data": shape, "label": (shape[0],)} if shape else None
+    net = CompiledNet(npm, TRAIN, feed_shapes=feed)
+    params, state = net.init(jax.random.PRNGKey(0))
+    rs = np.random.RandomState(0)
+    batch = {}
+    for name, s in net.feed_shapes().items():
+        batch[name] = rs.randint(0, 2, s).astype(np.int32) \
+            if name == "label" else rs.randn(*s).astype(np.float32)
+    blobs, _ = net.apply(params, state, batch, train=False)
+    for b in net.output_blobs:
+        assert np.isfinite(np.asarray(blobs[b], np.float32)).all(), \
+            f"{rel}: non-finite output {b}"
