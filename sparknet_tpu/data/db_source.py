@@ -105,7 +105,7 @@ def phase_data_layers(net_param, phase):
     from ..graph.compiler import filter_net
     out = []
     for lp in filter_net(net_param, phase).layer:
-        if lp.type in ("Data", "ImageData", "HDF5Data"):
+        if lp.type in ("Data", "ImageData", "HDF5Data", "WindowData"):
             out.append(lp)
     return out
 
@@ -122,7 +122,8 @@ def build_db_feed(net_param, phase, base_dir="", seed=None):
     synthetic feeds. This is what lets `sparknet train --solver
     cifar10_full_solver.prototxt` run the reference's most basic flow:
     stock prototxt -> real records -> trained net."""
-    from .file_sources import ImageDataSource, HDF5DataSource
+    from .file_sources import (ImageDataSource, HDF5DataSource,
+                               WindowDataSource)
     for lp in phase_data_layers(net_param, phase):
         tops = list(lp.top)
         tp = lp.transform_param if lp.has("transform_param") else None
@@ -150,6 +151,22 @@ def build_db_feed(net_param, phase, base_dir="", seed=None):
                 is_color=bool(int(ip.is_color)), shuffle=bool(int(ip.shuffle)),
                 rand_skip=int(ip.rand_skip), base_dir=base_dir, seed=seed,
                 data_top=tops[0],
+                label_top=tops[1] if len(tops) > 1 else "label")
+        elif lp.type == "WindowData" and lp.has("window_data_param"):
+            wp = lp.window_data_param
+            source = _resolve(wp.source, base_dir)
+            if not os.path.exists(source):
+                continue
+            src = WindowDataSource(
+                source, int(wp.batch_size), phase=phase, transform_param=tp,
+                fg_threshold=float(wp.fg_threshold),
+                bg_threshold=float(wp.bg_threshold),
+                fg_fraction=float(wp.fg_fraction),
+                context_pad=int(wp.context_pad),
+                crop_mode=wp.crop_mode,
+                root_folder=_resolve(wp.root_folder, base_dir)
+                if wp.root_folder else base_dir,
+                base_dir=base_dir, seed=seed, data_top=tops[0],
                 label_top=tops[1] if len(tops) > 1 else "label")
         elif lp.type == "HDF5Data" and lp.has("hdf5_data_param"):
             hp = lp.hdf5_data_param

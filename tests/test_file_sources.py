@@ -191,3 +191,93 @@ class TestBuildFeedDispatch:
         """, "NetParameter")
         shapes, src = build_db_feed(np_, TRAIN)
         assert shapes is None and src is None
+
+
+# ---------------------------------------------------------- WindowData ----
+
+class TestWindowDataSource:
+    def _make(self, tmp_path, n_images=2, size=24):
+        from PIL import Image
+        rs = np.random.RandomState(0)
+        lines = []
+        for i in range(n_images):
+            arr = rs.randint(0, 256, (size, size, 3), np.uint8)
+            p = tmp_path / f"img{i}.png"
+            Image.fromarray(arr).save(p)
+            lines += [f"# {i}", str(p), "3", str(size), str(size), "3",
+                      # fg window (overlap 0.9), fg (0.8), bg (0.1)
+                      f"{i + 1} 0.9 2 2 12 12",
+                      f"{i + 1} 0.8 5 5 20 20",
+                      "0 0.1 0 0 8 8"]
+        wf = tmp_path / "windows.txt"
+        wf.write_text("\n".join(lines) + "\n")
+        return str(wf)
+
+    def _source(self, tmp_path, **kw):
+        from sparknet_tpu.data.file_sources import WindowDataSource
+        from sparknet_tpu.proto import Message
+        tp = Message("TransformationParameter", crop_size=16)
+        defaults = dict(batch_size=8, transform_param=tp, fg_fraction=0.25,
+                        seed=0)
+        defaults.update(kw)
+        return WindowDataSource(self._make(tmp_path), **defaults)
+
+    def test_parse_and_split(self, tmp_path):
+        src = self._source(tmp_path)
+        assert len(src.fg) == 4 and len(src.bg) == 2
+        assert src.num_records == 6
+        assert src.shape == (8, 3, 16, 16)
+
+    def test_batch_composition_bg_then_fg(self, tmp_path):
+        src = self._source(tmp_path)
+        batch = next(iter(src))
+        assert batch["data"].shape == (8, 3, 16, 16)
+        labels = batch["label"]
+        # fg_fraction 0.25 of 8 -> 6 background (label 0) then 2 foreground
+        assert (labels[:6] == 0).all() and (labels[6:] > 0).all()
+        assert np.isfinite(batch["data"]).all()
+        assert np.abs(batch["data"]).max() > 0
+
+    def test_context_pad_leaves_zero_border(self, tmp_path):
+        # context_pad expands the region; a window at the image corner gets
+        # clipped and the out-of-image extent stays zero in the canvas
+        src = self._source(tmp_path, context_pad=4, fg_fraction=1.0,
+                           batch_size=4)
+        batch = next(iter(src))
+        assert batch["data"].shape == (4, 3, 16, 16)
+        assert np.isfinite(batch["data"]).all()
+
+    def test_fg_label_zero_rejected(self, tmp_path):
+        from sparknet_tpu.data.file_sources import WindowDataSource
+        from sparknet_tpu.proto import Message
+        wf = tmp_path / "bad.txt"
+        wf.write_text("# 0\n/nope.png\n3 8 8\n1\n0 0.9 0 0 4 4\n")
+        with pytest.raises(ValueError, match="label"):
+            WindowDataSource(str(wf), batch_size=2,
+                             transform_param=Message(
+                                 "TransformationParameter", crop_size=8))
+
+    def test_requires_crop_size(self, tmp_path):
+        from sparknet_tpu.data.file_sources import WindowDataSource
+        with pytest.raises(ValueError, match="crop_size"):
+            WindowDataSource(self._make(tmp_path), batch_size=2)
+
+    def test_stock_prototxt_dispatch(self, tmp_path):
+        """A WindowData net layer resolves through build_db_feed."""
+        from sparknet_tpu.data.db_source import build_db_feed
+        from sparknet_tpu.proto import Message
+        wf = self._make(tmp_path)
+        lp = Message("LayerParameter", name="wdata", type="WindowData",
+                     window_data_param=Message(
+                         "WindowDataParameter", source=wf, batch_size=4,
+                         fg_fraction=0.5),
+                     transform_param=Message("TransformationParameter",
+                                             crop_size=16))
+        lp.top.extend(["data", "label"])
+        net = Message("NetParameter")
+        net.layer.append(lp)
+        shapes, src = build_db_feed(net, 0, str(tmp_path), seed=0)
+        assert shapes == {"data": (4, 3, 16, 16), "label": (4,)}
+        batch = next(iter(src))
+        assert batch["data"].shape == (4, 3, 16, 16)
+        src.close()
