@@ -91,6 +91,11 @@ def pipeline_apply(block_fn, stacked_params, x, mesh, num_microbatches,
     M = num_microbatches
     if B % M:
         raise ValueError(f"batch {B} not divisible by microbatches {M}")
+    S = mesh.shape[axis]
+    L = jax.tree_util.tree_leaves(stacked_params)[0].shape[0]
+    if L % S:
+        raise ValueError(
+            f"block count {L} not divisible by pipeline stages {S}")
     mb = x.reshape(M, B // M, *x.shape[1:])
 
     def inner(params, xs):

@@ -142,3 +142,52 @@ def test_moe_in_transformer_net_trains():
     for _ in range(15):
         last = float(solver.train_step(batch))
     assert last < first - 0.5
+
+
+def _dense_mask_moe(layer, params, x):
+    """The O(n^2) one-hot-mask formulation (reference math, differentiable)
+    used to validate the production sort/scatter path's GRADIENTS."""
+    import math
+    router, w1, b1, w2, b2 = params
+    b, s, e = x.shape
+    n = b * s
+    X = router.shape[0]
+    xt = x.reshape(n, e)
+    logits = xt @ router.T
+    gates = jax.nn.softmax(logits, axis=-1)
+    idx = jnp.argmax(gates, axis=-1)
+    gate = jnp.take_along_axis(gates, idx[:, None], 1)[:, 0]
+    onehot = jax.nn.one_hot(idx, X)
+    pos = jnp.sum((jnp.cumsum(onehot, axis=0) - onehot) * onehot, axis=-1)
+    C = max(1, math.ceil(n / X * layer.capacity_factor))
+    keep = (pos < C).astype(jnp.float32)
+    slot = jax.nn.one_hot(pos.astype(jnp.int32), C) * keep[:, None]
+    mask = onehot[:, :, None] * slot[:, None, :]
+    xe = jnp.einsum("ne,nxc->xce", xt, mask)
+    h = jax.nn.relu(jnp.einsum("xce,xfe->xcf", xe, w1) + b1[:, None, :])
+    ye = jnp.einsum("xcf,xef->xce", h, w2) + b2[:, None, :]
+    y = jnp.einsum("xce,nxc->ne", ye, mask) * gate[:, None]
+    return y.reshape(b, s, e)
+
+
+def test_moe_gradients_match_dense_mask_formulation():
+    """The sort/scatter dispatch must be gradient-equivalent to the dense
+    one-hot-mask einsum formulation (same routing, same capacity)."""
+    layer, _ = make_layer("MoE", [(2, 6, 8)],
+                          moe_param=dict(num_experts=4))
+    params = _params(layer, seed=7)
+    x = jnp.asarray(np.random.RandomState(8).randn(2, 6, 8), jnp.float32)
+    tgt = jnp.asarray(np.random.RandomState(9).randn(2, 6, 8), jnp.float32)
+
+    def loss_prod(ps):
+        (y,) = layer.apply(ps, [x], True, None)
+        return jnp.sum((y - tgt) ** 2)
+
+    def loss_dense(ps):
+        return jnp.sum((_dense_mask_moe(layer, ps, x) - tgt) ** 2)
+
+    gp = jax.grad(loss_prod)(params)
+    gd = jax.grad(loss_dense)(params)
+    for a, b in zip(gp, gd):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=2e-4, rtol=1e-4)
