@@ -43,13 +43,23 @@ class DatumBatchSource:
 
     def __init__(self, source, batch_size, phase=0, transform_param=None,
                  backend="lmdb", rand_skip=0, base_dir="", seed=None,
-                 data_top="data", label_top="label"):
+                 data_top="data", label_top="label", device_transform=False):
         self.source = source
         self.batch_size = int(batch_size)
         self.data_top, self.label_top = data_top, label_top
         rng = np.random.RandomState(seed)
         self.transformer = DataTransformer(transform_param, phase=phase,
                                            base_dir=base_dir, rng=rng)
+        # device mode: yield the raw uint8 records + host-drawn crop/mirror
+        # randomness; the jitted step applies crop/mirror/mean on-chip
+        # (device_transform.py — a transfer-bound link ships 3.2-4x fewer
+        # bytes this way). The DeviceTransformer shares self.transformer's
+        # config AND rng, so both modes see the same augmentation stream.
+        self.device_mode = bool(device_transform)
+        if self.device_mode:
+            from .device_transform import DeviceTransformer
+            self._devt = DeviceTransformer(self.transformer,
+                                           data_top=data_top)
         self.db = open_db(source, backend)
         if len(self.db) == 0:
             raise ValueError(f"{source}: empty database")
@@ -92,8 +102,22 @@ class DatumBatchSource:
                 arr, labels[i] = next(rec)
                 arrs.append(arr.reshape(c, h, w))
             batch = np.stack(arrs)  # uint8, or float32 for float_data nets
-            yield {self.data_top: self.transformer(batch),
-                   self.label_top: labels}
+            if self.device_mode:
+                yield {self.data_top: batch, self.label_top: labels,
+                       **self._devt.aux(self.batch_size, self.record_shape)}
+            else:
+                yield {self.data_top: self.transformer(batch),
+                       self.label_top: labels}
+
+    @property
+    def device_fn(self):
+        """Jittable on-device transform (device mode only)."""
+        return self._devt.device_fn()
+
+    @property
+    def raw_feed_overrides(self):
+        """check_batch shape overrides for the raw feed (device mode)."""
+        return self._devt.raw_overrides(self.batch_size, self.record_shape)
 
     def close(self):
         self.db.close()
@@ -115,7 +139,8 @@ def _resolve(path, base_dir):
         if base_dir and not os.path.isabs(path) else path
 
 
-def build_db_feed(net_param, phase, base_dir="", seed=None):
+def build_db_feed(net_param, phase, base_dir="", seed=None,
+                  device_transform=False):
     """If the net's phase-filtered data layer points at an existing source
     (Data -> LMDB, ImageData -> listfile, HDF5Data -> list-of-h5), return
     (feed_shapes, source); else (None, None) — the caller falls back to
@@ -137,7 +162,8 @@ def build_db_feed(net_param, phase, base_dir="", seed=None):
                 backend=int(dp.backend) if dp.has("backend") else "lmdb",
                 rand_skip=int(dp.rand_skip), base_dir=base_dir, seed=seed,
                 data_top=tops[0],
-                label_top=tops[1] if len(tops) > 1 else "label")
+                label_top=tops[1] if len(tops) > 1 else "label",
+                device_transform=device_transform)
         elif lp.type == "ImageData" and lp.has("image_data_param"):
             ip = lp.image_data_param
             source = _resolve(ip.source, base_dir)
@@ -157,6 +183,13 @@ def build_db_feed(net_param, phase, base_dir="", seed=None):
             source = _resolve(wp.source, base_dir)
             if not os.path.exists(source):
                 continue
+            if wp.has("cache_images") and bool(int(wp.cache_images)):
+                import warnings
+                warnings.warn(
+                    f"layer {lp.name!r}: cache_images is ignored — "
+                    "WindowDataSource decodes per sampled window (the "
+                    "deliberate no-cache choice, file_sources.py), so "
+                    "expect per-window decode cost", stacklevel=2)
             src = WindowDataSource(
                 source, int(wp.batch_size), phase=phase, transform_param=tp,
                 fg_threshold=float(wp.fg_threshold),
@@ -185,7 +218,8 @@ def build_db_feed(net_param, phase, base_dir="", seed=None):
     return None, None
 
 
-def resolve_db_feed(net_param, phase, start_dir, seed=None):
+def resolve_db_feed(net_param, phase, start_dir, seed=None,
+                    device_transform=False):
     """build_db_feed with the CLI's walk-up source resolution: stock
     prototxt sources are caffe-root-relative, so try start_dir, then each
     parent, until a readable source appears. -> (shapes, src), or
@@ -195,7 +229,8 @@ def resolve_db_feed(net_param, phase, start_dir, seed=None):
         return None, None
     d = os.path.abspath(start_dir or ".")
     while True:
-        shapes, src = build_db_feed(net_param, phase, d, seed=seed)
+        shapes, src = build_db_feed(net_param, phase, d, seed=seed,
+                                    device_transform=device_transform)
         if src is not None:
             return shapes, src
         parent = os.path.dirname(d)

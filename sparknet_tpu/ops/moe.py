@@ -23,6 +23,16 @@ scatter/gather (O(n log n + n*C), not an O(n^2) one-hot mask) and run
 identically on 1 device and on an N-way expert mesh, so the two paths
 agree exactly (tested).
 
+Scaling caveat: the current EP path assumes tokens are REPLICATED across
+the "expert" axis — every device builds the full (X, C, E) dispatch
+buffer, so after the all_to_all each device runs its X/ep experts on ep
+copies of the capacity slots. That shards expert *weight memory* (the
+usual MoE limiter) but not per-device expert FLOPs. Shrinking compute
+too requires sharding tokens along the expert axis (route only the local
+batch slice, capacity C/ep per peer) — compose the "expert" axis with the
+"data"/"seq" axes for that; under dpxep the batch sharding already
+divides the token count per device.
+
 Weight blobs (expert-major so a GSPMD param_rule or shard_map in_spec can
 shard dim 0 across the expert axis):
   router (num_experts, E) | w1 (num_experts, F, E) | b1 (num_experts, F)

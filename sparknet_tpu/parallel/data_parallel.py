@@ -100,10 +100,13 @@ class DataParallelSolver(Solver):
         iter_size = int(self.param.iter_size)
         net, updater, lr_fn = self.local_net, self.updater, self.lr_fn
         axis = self.axis
+        loss_fn = self._wrapped_loss(net)   # device-side input transform
+        # (shape-polymorphic vmap, so the global-net transform applies
+        # unchanged to each shard's slice)
 
         def one_grad(params, state, batch, rng):
             def lf(p):
-                loss, (blobs, new_state) = net.loss_fn(p, state, batch, rng)
+                loss, (blobs, new_state) = loss_fn(p, state, batch, rng)
                 return loss, new_state
             (loss, new_state), grads = jax.value_and_grad(
                 lf, has_aux=True)(params)
@@ -169,8 +172,11 @@ class DataParallelSolver(Solver):
     def _build_eval_step(self):
         net = self.local_test_net
         axis = self.axis
+        tf = self.test_input_transform
 
         def ev(params, state, batch):
+            if tf is not None:
+                batch = tf(batch)
             blobs, _ = net.apply(params, state, batch, train=False)
             # test scores are batch means -> pmean across equal shards
             return {b: jax.lax.pmean(jnp.asarray(blobs[b], jnp.float32), axis)
@@ -223,10 +229,11 @@ class LocalSGDSolver(Solver):
         net, updater, lr_fn = self.net, self.updater, self.lr_fn
         axis, tau = self.axis, self.tau
         average_history = self.average_history
+        loss_fn = self._wrapped_loss(net)
 
         def one_step(params, state, history, batch, it, rng):
             def lf(p):
-                loss, (blobs, new_state) = net.loss_fn(p, state, batch, rng)
+                loss, (blobs, new_state) = loss_fn(p, state, batch, rng)
                 return loss, new_state
             (loss, new_state), grads = jax.value_and_grad(
                 lf, has_aux=True)(params)

@@ -100,7 +100,7 @@ class GSPMDSolver(Solver):
                     fn,
                     in_shardings=(ps_tree, state_sh, hist_sh,
                                   self._batch_sh, rep, rep),
-                    out_shardings=(ps_tree, state_sh, hist_sh, rep),
+                    out_shardings=(ps_tree, state_sh, hist_sh, rep, rep),
                     donate_argnums=(0, 1, 2))
             batch = {k: jax.device_put(np.asarray(v), self._batch_sh[k])
                      for k, v in batch.items()}

@@ -23,8 +23,6 @@ compute them replicated (or data-parallel) before/after ``pipeline_apply``
 — they are a tiny fraction of LM FLOPs.
 """
 
-import functools
-
 import jax
 import jax.numpy as jnp
 from jax import lax

@@ -106,6 +106,43 @@ class Solver:
         self._jit_train = None
         self._jit_eval = None
         self._timing = collections.defaultdict(float)
+        # optional on-device input transforms (data/device_transform.py):
+        # pure fns applied to the feed dict INSIDE the jitted step, letting
+        # the host ship raw uint8 records + tiny offset arrays instead of
+        # float32 crops (3-4x fewer H2D bytes)
+        self.input_transform = None
+        self.test_input_transform = None
+        self._raw_feed_shapes = None
+        # async-dispatch discipline: fetching ANY value from the device is
+        # a full host round trip (~100 ms on a remote-tunnel TPU), so the
+        # step loop only materializes a loss at display points, or every
+        # _sync_stride steps when display is off. Dispatches queue ahead in
+        # between — that queue IS the transfer/compute overlap. The NaN
+        # watchdog consequently sees losses with up to that much lag.
+        self._sync_stride = max(1, int(os.environ.get(
+            "SPARKNET_SYNC_STRIDE", "100")))
+        # iteration counter kept ON DEVICE: feeding a fresh host scalar
+        # every step is a blocking H2D put; a resident counter is free
+        self._it_dev = None
+
+    def set_input_transform(self, fn, raw_overrides=None, test_fn=None):
+        """Install on-device input transforms (before any step compiles).
+        fn/test_fn: pure fn(batch dict) -> net feed dict; raw_overrides:
+        {blob: raw shape} check_batch overrides for the pre-transform feed
+        (e.g. the uint8 source extent + '#y'/'#x'/'#flip' aux arrays)."""
+        self.input_transform = fn
+        self.test_input_transform = test_fn
+        self._raw_feed_shapes = dict(raw_overrides) if raw_overrides else None
+
+    def _wrapped_loss(self, net):
+        """net.loss_fn with the device-side input transform folded in."""
+        tf = self.input_transform
+        if tf is None:
+            return net.loss_fn
+
+        def lf(params, state, batch, rng):
+            return net.loss_fn(params, state, tf(batch), rng)
+        return lf
 
     # -- compiled steps ----------------------------------------------------
     def _build_train_step(self):
@@ -116,10 +153,11 @@ class Solver:
         sharding annotations (parallel.gspmd) or wrap it in shard_map."""
         iter_size = int(self.param.iter_size)
         net, updater, lr_fn = self.net, self.updater, self.lr_fn
+        loss_fn = self._wrapped_loss(net)
 
         def one_grad(params, state, batch, rng):
             def lf(p):
-                loss, (blobs, new_state) = net.loss_fn(p, state, batch, rng)
+                loss, (blobs, new_state) = loss_fn(p, state, batch, rng)
                 return loss, new_state
             (loss, new_state), grads = jax.value_and_grad(
                 lf, has_aux=True)(params)
@@ -143,14 +181,17 @@ class Solver:
                 loss = jnp.mean(losses)
             rate = lr_fn(it)
             params, history = updater(params, grads, history, rate, it)
-            return params, state, history, loss
+            return params, state, history, loss, it + 1
 
         return step
 
     def _build_eval_step(self):
         net = self.test_net
+        tf = self.test_input_transform
 
         def ev(params, state, batch):
+            if tf is not None:
+                batch = tf(batch)
             blobs, _ = net.apply(params, state, batch, train=False)
             return {b: blobs[b] for b in net.output_blobs}
 
@@ -170,10 +211,19 @@ class Solver:
         batch axis (shard_batch assembles the global array), so the
         expected leading batch dim shrinks accordingly."""
         pcount = jax.process_count()
-        for name, want in self.net.feed_shapes().items():
+        shapes = dict(self.net.feed_shapes())
+        if self._raw_feed_shapes:
+            # device-side transform: the host feeds the RAW source extent
+            # (+ aux offset arrays), not the net's post-transform shape
+            shapes.update(self._raw_feed_shapes)
+        for name, want in shapes.items():
+            if want is None:
+                # produced on-device (e.g. a device-resident dataset feeds
+                # data/label from HBM) — the host doesn't ship this blob
+                continue
             if name not in batch:
                 raise ValueError(f"batch missing feed blob {name!r} "
-                                 f"(needs {sorted(self.net.feed_shapes())})")
+                                 f"(needs {sorted(shapes)})")
             got = tuple(np.shape(batch[name]))
             expect = tuple(leading) + tuple(want)
             if pcount > 1 and expect:
@@ -200,9 +250,11 @@ class Solver:
         self.rng, key = jax.random.split(self.rng)
         batch = {k: jnp.asarray(v) for k, v in batch.items()}
         t0 = time.perf_counter()
-        self.params, self.state, self.history, loss = self._jit_train(
-            self.params, self.state, self.history, batch,
-            jnp.asarray(self.iter, jnp.int32), key)
+        if self._it_dev is None:
+            self._it_dev = jnp.asarray(self.iter, jnp.int32)
+        self.params, self.state, self.history, loss, self._it_dev = \
+            self._jit_train(self.params, self.state, self.history, batch,
+                            self._it_dev, key)
         self.iter += 1
         self._timing["train_step"] += time.perf_counter() - t0
         return loss
@@ -213,7 +265,12 @@ class Solver:
         tests (test_data_fn() -> fresh test batch iterator) and snapshots."""
         sp = self.param
         iter_size = int(sp.iter_size)
-        t_last, it_last = time.perf_counter(), self.iter
+        # throughput windows use the WALL clock: on remote-tunnel rigs the
+        # monotonic clock slews after long device waits (observed: 200
+        # pipelined steps billed 43 s by perf_counter vs 1.4 s wall), and
+        # an async step loop is exactly that workload. An NTP step can
+        # garble one metrics window; the dt > 0 guard drops it.
+        t_last, it_last = time.time(), self.iter
         for _ in range(num_iters):
             if sp.test_interval and self.iter % sp.test_interval == 0 and \
                     (self.iter > 0 or sp.test_initialization) and \
@@ -225,7 +282,7 @@ class Solver:
                     self.metrics.log("test", iter=self.iter,
                                      **{k: float(np.mean(v))
                                         for k, v in scores.items()})
-                t_last, it_last = time.perf_counter(), self.iter
+                t_last, it_last = time.time(), self.iter
             if iter_size == 1:
                 batch = next(data_iter)
             else:
@@ -233,23 +290,37 @@ class Solver:
                 batch = {k: np.stack([m[k] for m in micros])
                          for k in micros[0]}
             loss = self.train_step(batch)
-            self._smoothed.append(float(loss))
-            if self.watchdog is not None:
-                self.watchdog.beat(loss)
-            if sp.display and (self.iter - 1) % sp.display == 0:
-                sm = sum(self._smoothed) / len(self._smoothed)
+            # deferred sync: losses stay device handles; fetching one is a
+            # full round trip, so it happens at display points (or every
+            # _sync_stride steps) — dispatches queue ahead in between and
+            # the host never serializes transfer against compute
+            self._smoothed.append(loss)
+            disp = sp.display and (self.iter - 1) % sp.display == 0
+            if not disp:
+                if self.iter % self._sync_stride == 0:
+                    v = float(loss)
+                    if self.watchdog is not None:
+                        self.watchdog.beat(v)
+                elif self.watchdog is not None:
+                    self.watchdog.beat()
+            if disp:
+                # ONE fetch for the whole smoothing window
+                sm = float(jnp.mean(jnp.stack(
+                    [jnp.asarray(x) for x in self._smoothed])))
+                if self.watchdog is not None:
+                    self.watchdog.beat(sm)
                 lr = float(self.lr_fn(self.iter - 1))
                 self.log(f"Iteration {self.iter - 1}, loss = {sm:.6g}, "
                          f"lr = {lr:.6g}")
                 if self.metrics:
-                    dt = time.perf_counter() - t_last
+                    dt = time.time() - t_last
                     steps = self.iter - it_last
                     bsz = next(iter(self.net.feed_shapes().values()), (0,))
                     self.metrics.log(
                         "train", iter=self.iter - 1, loss=sm, lr=lr,
                         images_per_sec=round(steps * iter_size * bsz[0] / dt,
                                              2) if dt > 0 and bsz else None)
-                    t_last, it_last = time.perf_counter(), self.iter
+                    t_last, it_last = time.time(), self.iter
             if sp.snapshot and self.iter % sp.snapshot == 0 and \
                     sp.has("snapshot_prefix"):
                 self.snapshot()
@@ -261,16 +332,25 @@ class Solver:
             self._jit_eval = self._build_eval_step()
         n = num_iters or (int(self.param.test_iter[0])
                           if self.param.test_iter else 1)
+        # accumulate ON DEVICE: each batch's scores stay as async jax
+        # arrays, so the n eval dispatches (and their H2D feeds) pipeline;
+        # the only host sync is the final fetch
         sums = None
-        for i in range(n):
-            batch = {k: jnp.asarray(v) for k, v in next(data_iter).items()}
-            out = self._jit_eval(self.params, self.state, batch)
-            if sums is None:
-                sums = {k: np.asarray(v, np.float64) for k, v in out.items()}
-            else:
-                for k, v in out.items():
-                    sums[k] += np.asarray(v, np.float64)
-        return {k: v / n for k, v in sums.items()}
+        try:
+            for i in range(n):
+                batch = {k: jnp.asarray(v)
+                         for k, v in next(data_iter).items()}
+                out = self._jit_eval(self.params, self.state, batch)
+                if sums is None:
+                    sums = {k: jnp.asarray(v, jnp.float32)
+                            for k, v in out.items()}
+                else:
+                    sums = {k: sums[k] + jnp.asarray(out[k], jnp.float32)
+                            for k in sums}
+        finally:
+            if hasattr(data_iter, "close"):
+                data_iter.close()
+        return {k: np.asarray(v, np.float64) / n for k, v in sums.items()}
 
     # -- checkpointing (reference solver.cpp Snapshot :447-521) ------------
     def snapshot(self, prefix=None, format=None):
@@ -308,6 +388,7 @@ class Solver:
     def restore(self, state_path):
         """Resume from a .solverstate[.h5] (+ its learned_net weights)."""
         from . import hdf5_io
+        self._it_dev = None          # re-seed the device iter counter
         if state_path.endswith(".h5"):
             it, learned, self.history = hdf5_io.load_state_hdf5(
                 state_path, self.net, self.history)

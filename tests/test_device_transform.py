@@ -1,0 +1,233 @@
+"""On-device DataTransformer == native host kernel, bit for bit.
+
+The device path (data/device_transform.py) must reproduce the reference
+data_transformer.cpp:42-51 semantics the native host kernel
+(native/pipeline.cpp transform_batch) already implements: full-size mean
+subtracted at the source crop-window index BEFORE the mirror, per-channel
+mean after, then scale. Both paths share float32 op order, so the
+comparison below is exact (atol=0), not approximate.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from sparknet_tpu import native
+from sparknet_tpu.data.transforms import DataTransformer
+from sparknet_tpu.data.device_transform import (DeviceTransformer,
+                                                build_device_transformer,
+                                                aux_keys)
+from sparknet_tpu.proto import Message
+
+
+def _batch(n=6, c=3, h=40, w=40, seed=0):
+    return np.random.RandomState(seed).randint(
+        0, 256, (n, c, h, w)).astype(np.uint8)
+
+
+def _run_device(devt, images, aux):
+    fn = jax.jit(devt.device_fn())
+    out = fn({"data": jnp.asarray(images), "label": jnp.zeros(len(images)),
+              **{k: jnp.asarray(v) for k, v in aux.items()}})
+    assert set(out) == {"data", "label"}          # aux consumed
+    return np.asarray(out["data"])
+
+
+def test_crop_mirror_full_mean_scale_exact():
+    images = _batch()
+    n, c, h, w = images.shape
+    crop = 28
+    mean = np.random.RandomState(1).rand(c, h, w).astype(np.float32) * 120
+    rs = np.random.RandomState(2)
+    ys = rs.randint(0, h - crop + 1, n).astype(np.int32)
+    xs = rs.randint(0, w - crop + 1, n).astype(np.int32)
+    flips = rs.randint(0, 2, n).astype(np.uint8)
+
+    host = native.transform_batch(images, crop, ys=ys, xs=xs, mirror=flips,
+                                  mean=mean, scale=0.00390625,
+                                  full_mean=True)
+
+    tp = Message("TransformationParameter", crop_size=crop, mirror=True,
+                 scale=0.00390625)
+    devt = build_device_transformer(tp, phase=0)
+    devt.h.mean, devt.h.full_mean = mean, True    # bypass mean_file I/O
+    ky, kx, kf = aux_keys("data")
+    dev = _run_device(devt, images, {ky: ys, kx: xs, kf: flips})
+    np.testing.assert_array_equal(dev, host)
+
+
+def test_no_crop_full_mean_exact_cifar_shape():
+    # the cifar10_full configuration: mean_file only, no crop, no mirror
+    images = _batch(8, 3, 32, 32, seed=3)
+    mean = np.random.RandomState(4).rand(3, 32, 32).astype(np.float32) * 100
+    tp = Message("TransformationParameter")
+    host_t = DataTransformer(tp, phase=0, rng=np.random.RandomState(0))
+    host_t.mean, host_t.full_mean = mean, True
+    host = host_t(images)
+
+    devt = DeviceTransformer(
+        DataTransformer(tp, phase=0, rng=np.random.RandomState(0)))
+    devt.h.mean, devt.h.full_mean = mean, True
+    dev = _run_device(devt, images, {})
+    np.testing.assert_array_equal(dev, host)
+
+
+def test_per_channel_mean_and_center_crop_test_phase():
+    images = _batch(5, 3, 36, 36, seed=5)
+    crop = 24
+    tp = Message("TransformationParameter", crop_size=crop, scale=2.0)
+    tp.mean_value.extend([10.0, 20.0, 30.0])
+    seed = 7
+    host_t = DataTransformer(tp, phase=1, rng=np.random.RandomState(seed))
+    host = host_t(images)
+
+    devt = build_device_transformer(tp, phase=1,
+                                    rng=np.random.RandomState(seed))
+    aux = devt.aux(len(images), images.shape[1:])
+    dev = _run_device(devt, images, aux)
+    np.testing.assert_array_equal(dev, host)
+
+
+def test_shared_rng_matches_host_stream_train_phase():
+    # same seed => host mode and device mode draw identical augmentations
+    images = _batch(10, 3, 32, 32, seed=8)
+    crop = 28
+    tp = Message("TransformationParameter", crop_size=crop, mirror=True)
+    host_t = DataTransformer(tp, phase=0, rng=np.random.RandomState(11))
+    host = host_t(images)
+
+    devt = build_device_transformer(tp, phase=0,
+                                    rng=np.random.RandomState(11))
+    aux = devt.aux(len(images), images.shape[1:])
+    dev = _run_device(devt, images, aux)
+    np.testing.assert_array_equal(dev, host)
+
+
+def test_raw_overrides_shapes():
+    tp = Message("TransformationParameter", crop_size=20, mirror=True)
+    devt = build_device_transformer(tp, phase=0)
+    over = devt.raw_overrides(16, (3, 32, 32))
+    ky, kx, kf = aux_keys("data")
+    assert over == {"data": (16, 3, 32, 32), ky: (16,), kx: (16,),
+                    kf: (16,)}
+
+
+def test_solver_device_transform_end_to_end(tmp_path):
+    """A Solver fed raw uint8 + aux under set_input_transform reaches the
+    same loss as one fed the host-transformed float batch (same params,
+    same rng key) — the transform really runs inside the jitted step."""
+    from sparknet_tpu.models import zoo
+    from sparknet_tpu.solver.solver import Solver
+
+    tp = Message("TransformationParameter", crop_size=24, mirror=True)
+    images = _batch(16, 3, 32, 32, seed=13)
+    labels = np.random.RandomState(14).randint(0, 10, 16)
+
+    seed = 21
+    host_t = DataTransformer(tp, phase=0, rng=np.random.RandomState(seed))
+    host_batch = {"data": host_t(images), "label": labels}
+
+    devt = build_device_transformer(tp, phase=0,
+                                    rng=np.random.RandomState(seed))
+    aux = devt.aux(16, (3, 32, 32))
+    raw_batch = {"data": images, "label": labels, **aux}
+
+    def mk():
+        sp = Message("SolverParameter", base_lr=0.01, lr_policy="fixed",
+                     display=0, random_seed=5)
+        return Solver(sp, net_param=zoo.cifar10_full(batch_size=16),
+                      feed_shapes={"data": (16, 3, 24, 24), "label": (16,)})
+
+    s_host = mk()
+    l_host = float(s_host.train_step(host_batch))
+
+    s_dev = mk()
+    s_dev.set_input_transform(devt.device_fn(),
+                              devt.raw_overrides(16, (3, 32, 32)))
+    l_dev = float(s_dev.train_step(raw_batch))
+    assert l_host == pytest.approx(l_dev, rel=1e-6)
+    # and the updated params agree
+    for k in s_host.params:
+        for a, b in zip(jax.tree_util.tree_leaves(s_host.params[k]),
+                        jax.tree_util.tree_leaves(s_dev.params[k])):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-6, atol=1e-6)
+
+
+def _make_lmdb(path, n=60, c=3, h=32, w=32, seed=0):
+    from sparknet_tpu.data.lmdb import LMDBWriter
+    from sparknet_tpu.data.datum import array_to_datum
+    rs = np.random.RandomState(seed)
+    imgs = rs.randint(0, 256, (n, c, h, w)).astype(np.uint8)
+    labels = rs.randint(0, 10, n)
+    with LMDBWriter(path) as wtr:
+        for i in range(n):
+            wtr.put(b"%08d" % i, array_to_datum(imgs[i], int(labels[i])))
+    return imgs, labels
+
+
+def test_device_cache_matches_streaming(tmp_path):
+    """Device-cached source (HBM-resident records + ctl-array steps) yields
+    the same transformed batches as the streaming device mode — same
+    sequential cursor, same host rng draws."""
+    from sparknet_tpu.data.db_source import DatumBatchSource
+    from sparknet_tpu.data.device_cache import (DeviceCachedSource,
+                                                maybe_device_cache)
+    imgs, labels = _make_lmdb(str(tmp_path / "db"))
+    tp = Message("TransformationParameter", crop_size=28, mirror=True)
+
+    def mk(seed):
+        return DatumBatchSource(str(tmp_path / "db"), 16,
+                                transform_param=tp, seed=seed,
+                                device_transform=True)
+
+    stream = mk(7)
+    sfn = jax.jit(stream.device_fn)
+    cached = maybe_device_cache(mk(7))
+    assert isinstance(cached, DeviceCachedSource)
+    cfn = jax.jit(cached.device_fn)
+    si, ci = iter(stream), iter(cached)
+    for _ in range(5):      # crosses the 60-record wrap at batch 4
+        sb = {k: jnp.asarray(v) for k, v in next(si).items()}
+        sout = sfn(sb)
+        cb = {k: jnp.asarray(v) for k, v in next(ci).items()}
+        cout = cfn(cb)
+        np.testing.assert_array_equal(np.asarray(cout["data"]),
+                                      np.asarray(sout["data"]))
+        np.testing.assert_array_equal(np.asarray(cout["label"]),
+                                      np.asarray(sout["label"]))
+    assert cached.raw_feed_overrides["data"] is None
+    assert cached.raw_feed_overrides["label"] is None
+    assert cached.raw_feed_overrides["data#ctl"] == (16, 4)
+
+
+def test_device_cache_budget_gate(tmp_path):
+    from sparknet_tpu.data.db_source import DatumBatchSource
+    from sparknet_tpu.data.device_cache import maybe_device_cache
+    _make_lmdb(str(tmp_path / "db"))
+    src = DatumBatchSource(str(tmp_path / "db"), 16, device_transform=True)
+    assert maybe_device_cache(src, budget_mb=1e-6) is src   # too big
+    host = DatumBatchSource(str(tmp_path / "db"), 16)
+    assert maybe_device_cache(host) is host                 # host mode
+
+
+def test_check_batch_raw_overrides_errors():
+    from sparknet_tpu.models import zoo
+    from sparknet_tpu.solver.solver import Solver
+    tp = Message("TransformationParameter", crop_size=24)
+    devt = build_device_transformer(tp, phase=0)
+    sp = Message("SolverParameter", base_lr=0.01, lr_policy="fixed",
+                 display=0)
+    s = Solver(sp, net_param=zoo.cifar10_full(batch_size=4),
+               feed_shapes={"data": (4, 3, 24, 24), "label": (4,)})
+    s.set_input_transform(devt.device_fn(),
+                          devt.raw_overrides(4, (3, 32, 32)))
+    ky, kx, _ = aux_keys("data")
+    good = {"data": np.zeros((4, 3, 32, 32), np.uint8),
+            "label": np.zeros(4, np.int32),
+            ky: np.zeros(4, np.int32), kx: np.zeros(4, np.int32)}
+    s.check_batch(good)                            # raw extent accepted
+    bad = dict(good, data=np.zeros((4, 3, 24, 24), np.float32))
+    with pytest.raises(ValueError, match="data"):
+        s.check_batch(bad)                         # cropped shape rejected
