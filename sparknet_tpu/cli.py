@@ -415,6 +415,92 @@ def cmd_cifar(args):
     return 0
 
 
+def cmd_lm(args):
+    """Transformer-LM training driver on the synthetic bigram corpus —
+    the zoo's long-context family end to end: plain single-device Solver,
+    or the GPipe pipeline (--pipeline-stages N -> PipelineLMSolver over a
+    "pipe" mesh axis). Emits a JSONL loss curve whose floor (the corpus
+    bigram entropy) is logged up front, so convergence is checkable."""
+    import time as _time
+    import numpy as np
+    import jax.numpy as jnp
+    from .proto import Message
+    from .data.synthetic import lm_batch_stream
+    from .utils.metrics import MetricsLogger
+
+    if args.snapshot_every and not args.snapshot_prefix:
+        raise SystemExit("--snapshot-every needs --snapshot-prefix")
+    sp = Message("SolverParameter", base_lr=args.lr, lr_policy="fixed",
+                 display=args.display, type=args.solver_type,
+                 random_seed=args.seed,
+                 snapshot=args.snapshot_every or 0)
+    if args.snapshot_prefix:
+        sp.snapshot_prefix = args.snapshot_prefix
+    metrics = MetricsLogger(args.metrics) if args.metrics else None
+    lm_kw = dict(vocab_size=args.vocab, seq_len=args.seq_len,
+                 batch_size=args.batch, d_model=args.d_model,
+                 num_heads=args.heads, flash=not args.no_flash)
+    dtype = jnp.bfloat16 if args.dtype == "bf16" else jnp.float32
+    stream, floor = lm_batch_stream(args.vocab, args.batch, args.seq_len,
+                                    seed=args.seed)
+    if metrics:
+        metrics.log("config", loss_floor_nats=round(floor, 4),
+                    d_model=args.d_model, layers=args.layers,
+                    seq_len=args.seq_len, batch=args.batch,
+                    pipeline_stages=args.pipeline_stages,
+                    dtype=args.dtype)
+    print(f"bigram corpus floor: {floor:.4f} nats/token "
+          f"(untrained: {np.log(args.vocab):.4f})")
+
+    if args.pipeline_stages > 1:
+        from .parallel import PipelineLMSolver, make_mesh
+        if args.moe_experts:
+            raise SystemExit("--moe-experts is not supported under "
+                             "--pipeline-stages (dense-FFN blocks only)")
+        solver = PipelineLMSolver(
+            sp, mesh=make_mesh({"pipe": args.pipeline_stages}),
+            num_layers=args.layers,
+            num_microbatches=args.microbatches or None,
+            metrics=metrics, dtype=dtype, **lm_kw)
+        solver.snapshot_prefix = args.snapshot_prefix
+        if args.resume:
+            solver.restore(args.resume)
+        start_iter = solver.iter
+        t0 = _time.time()
+        solver.step(args.steps - solver.iter, stream)
+    else:
+        from .solver.solver import Solver
+        from .models import zoo
+        net = zoo.transformer_lm(num_layers=args.layers,
+                                 moe_experts=args.moe_experts, **lm_kw)
+        solver = Solver(sp, net_param=net, metrics=metrics, dtype=dtype)
+        if args.resume:
+            solver.restore(args.resume)
+        start_iter = solver.iter
+        t0 = _time.time()
+        solver.step(args.steps - solver.iter, iter(stream))
+    dt = _time.time() - t0
+    executed = solver.iter - start_iter
+    toks = executed * args.batch * args.seq_len
+    if getattr(solver, "_smoothed", None):
+        final = float(jnp.mean(jnp.stack(
+            [jnp.asarray(x) for x in solver._smoothed])))
+    elif getattr(solver, "_last_loss", None) is not None:
+        final = float(solver._last_loss)
+    else:
+        final = None
+    if args.snapshot_prefix:
+        solver.snapshot(args.snapshot_prefix)
+    rate = toks / dt if dt > 0 else 0
+    print(f"done: {executed} steps, {rate:,.0f} tokens/s wall, "
+          f"final loss {final}")
+    if metrics:
+        metrics.log("summary", steps=executed,
+                    tokens_per_sec=round(rate, 1),
+                    final_loss=final, loss_floor_nats=round(floor, 4))
+    return 0
+
+
 def cmd_imagenet(args):
     from .apps import ImageNetApp
     app = ImageNetApp(num_workers=args.workers, strategy=args.strategy,
@@ -571,6 +657,34 @@ def main(argv=None):
     c.add_argument("--metrics", help="JSONL metrics output path")
     c.set_defaults(fn=cmd_cifar)
 
+    lm = sub.add_parser("lm", help="transformer-LM driver (synthetic "
+                                   "bigram corpus; optional GPipe pipeline)")
+    lm.add_argument("--vocab", type=int, default=512)
+    lm.add_argument("--seq-len", type=int, default=256)
+    lm.add_argument("--batch", type=int, default=8)
+    lm.add_argument("--d-model", type=int, default=256)
+    lm.add_argument("--layers", type=int, default=4)
+    lm.add_argument("--heads", type=int, default=8)
+    lm.add_argument("--steps", type=int, default=500)
+    lm.add_argument("--lr", type=float, default=3e-4)
+    lm.add_argument("--solver-type", default="Adam")
+    lm.add_argument("--seed", type=int, default=0)
+    lm.add_argument("--display", type=int, default=50)
+    lm.add_argument("--dtype", choices=("f32", "bf16"), default="f32")
+    lm.add_argument("--no-flash", action="store_true",
+                    help="dense attention instead of the pallas kernel")
+    lm.add_argument("--moe-experts", type=int, default=0)
+    lm.add_argument("--pipeline-stages", type=int, default=1,
+                    help="N>1: run the trunk as an N-stage GPipe pipeline "
+                         "over a pipe mesh axis (PipelineLMSolver)")
+    lm.add_argument("--microbatches", type=int, default=0)
+    lm.add_argument("--metrics", help="JSONL loss-curve output path")
+    lm.add_argument("--snapshot-every", type=int, default=0)
+    lm.add_argument("--snapshot-prefix")
+    lm.add_argument("--resume", help=".lm.npz (pipeline) or "
+                                     ".solverstate.h5 to resume from")
+    lm.set_defaults(fn=cmd_lm)
+
     i = sub.add_parser("imagenet", help="ImageNetApp driver")
     i.add_argument("--workers", type=int, default=None)
     i.add_argument("--strategy", choices=("local_sgd", "dp"),
@@ -585,7 +699,7 @@ def main(argv=None):
 
     args = p.parse_args(argv)
     if args.verb in ("train", "test", "time", "device_query", "cifar",
-                     "imagenet"):
+                     "imagenet", "lm"):
         # multi-host bootstrap (no-op single-process; SPARKNET_COORDINATOR
         # et al. select the jax.distributed rendezvous — see DEPLOY.md)
         from .parallel import distributed_init

@@ -327,3 +327,56 @@ def transformer_lm(vocab_size=512, seq_len=256, batch_size=8, d_model=256,
         SoftmaxWithLoss("loss", ["lm_head", "label"], axis=2),
     ]
     return NetParam("TransformerLM", *layers)
+
+
+def transformer_lm_pieces(vocab_size=512, seq_len=256, batch_size=8,
+                          d_model=256, num_heads=8, d_ff=None,
+                          max_positions=None, flash=True):
+    """transformer_lm split for pipeline parallelism: (prefix, block,
+    suffix) NetParams.
+
+    The trunk block is expressed ONCE; PipelineLMSolver stacks L inits of
+    it on a leading dim and runs them as GPipe stages over a "pipe" mesh
+    axis (parallel/pipeline_solver.py). Embedding (prefix) and head+loss
+    (suffix) stay outside the pipeline, replicated — the stage-
+    heterogeneous ends the pipeline docstring plans for.
+
+    Layer names match transformer_lm's per-block names (ln1/attn/ffn1/
+    ffn2...) so params map 1:1 onto "block{i}/<name>" for equivalence
+    tests and checkpoint conversion.
+    """
+    d_ff = d_ff or 4 * d_model
+    max_positions = max_positions or seq_len
+    xavier = dict(type="xavier")
+    prefix = NetParam(
+        "TransformerLM_prefix",
+        RDDLayer("data", [batch_size, seq_len]),
+        EmbedLayer("tok_embed", ["data"], vocab_size, d_model,
+                   weight_filler=xavier),
+        PositionalEmbedLayer("pos_embed", ["tok_embed"], max_positions,
+                             d_model, weight_filler=xavier, tops=["embed"]),
+    )
+    block = NetParam(
+        "TransformerLM_block",
+        RDDLayer("x", [batch_size, seq_len, d_model]),
+        LayerNormLayer("ln1", ["x"]),
+        AttentionLayer("attn", ["ln1"], num_heads, causal=True, flash=flash),
+        EltwiseLayer("res1", ["x", "attn"]),
+        LayerNormLayer("ln2", ["res1"]),
+        InnerProductLayer("ffn1", ["ln2"], d_ff, weight_filler=xavier,
+                          axis=2),
+        ReLULayer("relu", ["ffn1"], tops=["ffn1"]),
+        InnerProductLayer("ffn2", ["ffn1"], d_model, weight_filler=xavier,
+                          axis=2),
+        EltwiseLayer("res2", ["res1", "ffn2"]),
+    )
+    suffix = NetParam(
+        "TransformerLM_suffix",
+        RDDLayer("x", [batch_size, seq_len, d_model]),
+        RDDLayer("label", [batch_size, seq_len]),
+        LayerNormLayer("ln_f", ["x"]),
+        InnerProductLayer("lm_head", ["ln_f"], vocab_size,
+                          weight_filler=xavier, axis=2),
+        SoftmaxWithLoss("loss", ["lm_head", "label"], axis=2),
+    )
+    return prefix, block, suffix

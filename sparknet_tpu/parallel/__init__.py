@@ -28,7 +28,7 @@ __all__ = [
     "DataParallelSolver", "LocalSGDSolver", "shard_batch",
     "GSPMDSolver", "default_param_rule",
     "ring_attention", "ulysses_attention", "sequence_sharded_apply",
-    "gpipe", "pipeline_apply", "stack_params",
+    "gpipe", "pipeline_apply", "stack_params", "PipelineLMSolver",
 ]
 
 # lazy exports (PEP 562): ops.attention imports parallel.{context,ring} while
@@ -45,6 +45,7 @@ _EXPORTS = {
     "sequence_sharded_apply": "ring",
     "gpipe": "pipeline", "pipeline_apply": "pipeline",
     "stack_params": "pipeline",
+    "PipelineLMSolver": "pipeline_solver",
 }
 
 

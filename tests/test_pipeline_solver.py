@@ -1,0 +1,118 @@
+"""PipelineLMSolver: the GPipe trunk as a solver strategy.
+
+The VERDICT round-2 gap: pipeline_apply was tested but unreachable from
+any solver. These tests assert the integrated path — a 4-stage pipelined
+transformer LM step produces the SAME loss and updated params as the
+unpipelined zoo.transformer_lm on a single device with identical param
+values and batch (gradient equivalence through scan + ppermute), plus
+snapshot/restore and the divisibility guards.
+"""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from sparknet_tpu.models import zoo
+from sparknet_tpu.proto import Message
+from sparknet_tpu.parallel import PipelineLMSolver, make_mesh
+from sparknet_tpu.solver.solver import Solver
+
+LM = dict(vocab_size=64, seq_len=32, batch_size=8, d_model=32, num_heads=4,
+          flash=False)
+L = 4
+
+
+def _mk_pipeline(stages=4, tau_seed=3, **kw):
+    sp = Message("SolverParameter", base_lr=0.1, lr_policy="fixed",
+                 momentum=0.9, display=0, random_seed=tau_seed)
+    mesh = make_mesh({"pipe": stages})
+    return PipelineLMSolver(sp, mesh=mesh, num_layers=L,
+                            num_microbatches=4, **LM, **kw)
+
+
+def _mk_reference(psolver, tau_seed=3):
+    """zoo.transformer_lm Solver with params COPIED from the pipeline
+    solver (prefix/blocks/suffix layout -> per-block layer names)."""
+    sp = Message("SolverParameter", base_lr=0.1, lr_policy="fixed",
+                 momentum=0.9, display=0, random_seed=tau_seed)
+    s = Solver(sp, net_param=zoo.transformer_lm(num_layers=L, **LM))
+
+    def cp(x):
+        return jnp.asarray(np.asarray(x))   # break donation aliasing
+
+    params = {ln: list(blobs) for ln, blobs in s.params.items()}
+    params["tok_embed"] = [cp(x) for x in psolver.params["prefix/tok_embed"]]
+    params["pos_embed"] = [cp(x) for x in psolver.params["prefix/pos_embed"]]
+    for zname, pname in (("ln_f", "suffix/ln_f"),
+                         ("lm_head", "suffix/lm_head")):
+        params[zname] = [cp(x) for x in psolver.params[pname]]
+    for i in range(L):
+        for ln in ("ln1", "attn", "ln2", "ffn1", "ffn2"):
+            key = f"blocks/{ln}"
+            if key in psolver.params:
+                params[f"block{i}/{ln}"] = [cp(leaf[i])
+                                            for leaf in psolver.params[key]]
+    s.params = params
+    return s
+
+
+def _batch(seed=0):
+    rs = np.random.RandomState(seed)
+    return {"data": rs.randint(0, 64, (8, 32)).astype(np.int32),
+            "label": rs.randint(0, 64, (8, 32)).astype(np.int32)}
+
+
+def test_gradient_equivalence_vs_single_device():
+    ps = _mk_pipeline(stages=4)
+    ref = _mk_reference(ps)
+    batch = _batch()
+    l_ref = float(ref.train_step(batch))
+    l_pipe = float(ps.train_step(batch))
+    assert l_ref == pytest.approx(l_pipe, rel=2e-4)
+    # updated params agree: same grads flowed through the pipeline
+    for i in range(L):
+        for ln in ("ln1", "attn", "ln2", "ffn1", "ffn2"):
+            key = f"blocks/{ln}"
+            if key not in ps.params:
+                continue
+            for slot, leaf in enumerate(ps.params[key]):
+                np.testing.assert_allclose(
+                    np.asarray(leaf[i]),
+                    np.asarray(ref.params[f"block{i}/{ln}"][slot]),
+                    rtol=2e-3, atol=2e-5,
+                    err_msg=f"block{i}/{ln}[{slot}]")
+    for pname, zname in (("prefix/tok_embed", "tok_embed"),
+                         ("suffix/lm_head", "lm_head")):
+        for slot, leaf in enumerate(ps.params[pname]):
+            np.testing.assert_allclose(
+                np.asarray(leaf), np.asarray(ref.params[zname][slot]),
+                rtol=2e-3, atol=2e-5, err_msg=f"{pname}[{slot}]")
+
+
+def test_loss_decreases_over_steps():
+    ps = _mk_pipeline(stages=2)
+    batch = _batch(1)
+    first = float(ps.train_step(batch))
+    for _ in range(20):
+        last = ps.train_step(batch)
+    assert float(last) < first        # memorizes the fixed batch
+
+
+def test_snapshot_restore_round_trip(tmp_path):
+    ps = _mk_pipeline(stages=2)
+    batch = _batch(2)
+    ps.train_step(batch)
+    path = ps.snapshot(str(tmp_path / "lm"))
+    l_next = float(ps.train_step(batch))
+
+    ps2 = _mk_pipeline(stages=2, tau_seed=99)   # different init
+    ps2.restore(path)
+    assert ps2.iter == 1
+    l_resumed = float(ps2.train_step(batch))
+    assert l_resumed == pytest.approx(l_next, rel=1e-5)
+
+
+def test_stage_divisibility_guard():
+    with pytest.raises(ValueError, match="divisible"):
+        _mk_pipeline(stages=8)        # L=4 blocks across 8 stages
