@@ -316,7 +316,8 @@ def cmd_convert_imageset(args):
     tools.convert_imageset(args.root, args.listfile, args.db,
                            resize_height=args.resize_height,
                            resize_width=args.resize_width, gray=args.gray,
-                           shuffle=args.shuffle, encoded=args.encoded)
+                           shuffle=args.shuffle, encoded=args.encoded,
+                           backend=args.backend)
     return 0
 
 
@@ -482,13 +483,7 @@ def cmd_lm(args):
     dt = _time.time() - t0
     executed = solver.iter - start_iter
     toks = executed * args.batch * args.seq_len
-    if getattr(solver, "_smoothed", None):
-        final = float(jnp.mean(jnp.stack(
-            [jnp.asarray(x) for x in solver._smoothed])))
-    elif getattr(solver, "_last_loss", None) is not None:
-        final = float(solver._last_loss)
-    else:
-        final = None
+    final = solver.smoothed_loss()
     if args.snapshot_prefix:
         solver.snapshot(args.snapshot_prefix)
     rate = toks / dt if dt > 0 else 0
@@ -600,7 +595,7 @@ def main(argv=None):
     cm.set_defaults(fn=cmd_compute_mean)
 
     ci = sub.add_parser("convert_imageset",
-                        help='images + "path label" listfile -> Datum LMDB')
+                        help='images + "path label" listfile -> Datum DB')
     ci.add_argument("root", help="root folder of image paths")
     ci.add_argument("listfile")
     ci.add_argument("db")
@@ -609,6 +604,8 @@ def main(argv=None):
     ci.add_argument("--gray", action="store_true")
     ci.add_argument("--shuffle", action="store_true")
     ci.add_argument("--encoded", action="store_true")
+    ci.add_argument("--backend", choices=["lmdb", "leveldb"],
+                    default="lmdb")
     ci.set_defaults(fn=cmd_convert_imageset)
 
     for verb, bin_ in (("upgrade_net_proto_text", False),

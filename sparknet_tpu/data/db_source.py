@@ -19,18 +19,22 @@ from .transforms import DataTransformer
 
 
 def open_db(source, backend="lmdb"):
-    """DataParameter.DB -> reader. The reference supports LEVELDB and LMDB
-    (db.hpp GetDB); here LMDB is native and LevelDB is unsupported (its
-    snappy-compressed SSTables need a native dependency this environment
-    deliberately avoids) — convert with `sparknet convert_imageset`."""
+    """DataParameter.DB -> reader (db.cpp:10-22 GetDB dispatch). Both
+    backends read through pure-Python format implementations: LMDB B+tree
+    pages (lmdb.py) and LevelDB SSTables+MANIFEST+WAL with snappy blocks
+    (leveldb.py). backend=None sniffs the directory layout."""
     if isinstance(backend, int):
         backend = {0: "leveldb", 1: "lmdb"}[backend]
+    if backend is None:
+        backend = "leveldb" if os.path.exists(
+            os.path.join(source, "CURRENT")) else "lmdb"
     backend = backend.lower()
     if backend == "lmdb":
         return LMDBReader(source)
-    raise NotImplementedError(
-        f"backend {backend!r}: only LMDB databases are readable "
-        "(re-create LevelDB sources with `sparknet convert_imageset`)")
+    if backend == "leveldb":
+        from .leveldb import LevelDBReader
+        return LevelDBReader(source)
+    raise ValueError(f"unknown DB backend {backend!r}")
 
 
 class DatumBatchSource:
@@ -155,11 +159,12 @@ def build_db_feed(net_param, phase, base_dir="", seed=None,
         if lp.type == "Data" and lp.has("data_param"):
             dp = lp.data_param
             source = _resolve(dp.source, base_dir)
-            if not os.path.exists(_db_file(source)):
+            backend = int(dp.backend) if dp.has("backend") else None
+            if not _db_exists(source, backend):
                 continue
             src = DatumBatchSource(
                 source, int(dp.batch_size), phase=phase, transform_param=tp,
-                backend=int(dp.backend) if dp.has("backend") else "lmdb",
+                backend=backend,
                 rand_skip=int(dp.rand_skip), base_dir=base_dir, seed=seed,
                 data_top=tops[0],
                 label_top=tops[1] if len(tops) > 1 else "label",
@@ -242,3 +247,13 @@ def resolve_db_feed(net_param, phase, start_dir, seed=None,
 def _db_file(source):
     return os.path.join(source, "data.mdb") if not source.endswith(".mdb") \
         else source
+
+
+def _db_exists(source, backend):
+    """Does a readable DB of the declared (or sniffed) backend live here?"""
+    if backend in (0, "leveldb"):
+        return os.path.exists(os.path.join(source, "CURRENT"))
+    if backend in (1, "lmdb"):
+        return os.path.exists(_db_file(source))
+    return os.path.exists(_db_file(source)) or \
+        os.path.exists(os.path.join(source, "CURRENT"))

@@ -150,6 +150,8 @@ def bigram_corpus(vocab_size=512, seed=0, concentration=0.3):
     row_ent = -(probs * np.log(np.maximum(probs, 1e-12))).sum(1)
     floor = float(pi @ row_ent)
     cum = np.cumsum(probs, axis=1)
+    cum[:, -1] = 1.0   # float cumsum can end at 1-eps; u above it would
+    #                    index one past the vocab
 
     def sample(n, seq_len, rng):
         toks = np.empty((n, seq_len + 1), np.int32)

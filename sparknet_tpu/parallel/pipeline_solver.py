@@ -158,6 +158,13 @@ class PipelineLMSolver:
                        donate_argnums=(0, 1))
 
     # -- public API --------------------------------------------------------
+    def smoothed_loss(self):
+        """Latest step loss (one fetch), or None before any step — same
+        accessor Solver exposes, so drivers stay solver-agnostic."""
+        if self._last_loss is None:
+            return None
+        return float(self._last_loss)
+
     def train_step(self, batch):
         if self._jit_train is None:
             self._jit_train = self._build_train_step()

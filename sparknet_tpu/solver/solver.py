@@ -55,6 +55,11 @@ def resolve_nets(sp, base_dir="", net_param=None):
     return train, test
 
 
+def sp_test_scheduled(sp):
+    """Does the solver schedule testing (test_iter/test_interval set)?"""
+    return bool(sp.test_iter) or int(sp.test_interval) > 0
+
+
 class Solver:
     """Drives training of one net per the SolverParameter schedule.
 
@@ -80,9 +85,18 @@ class Solver:
                                dtype=dtype)
         self.test_net = None
         if test_np is not None:
-            self.test_net = CompiledNet(
-                test_np, TEST,
-                feed_shapes=test_feed_shapes or feed_shapes, dtype=dtype)
+            try:
+                self.test_net = CompiledNet(
+                    test_np, TEST,
+                    feed_shapes=test_feed_shapes or feed_shapes, dtype=dtype)
+            except ValueError:
+                # a shared `net` whose data layer is TRAIN-only has no
+                # TEST-phase graph; without a test_iter schedule the
+                # reference never instantiates test nets at all
+                # (solver.cpp InitTestNets), so train-only it is
+                if sp_test_scheduled(solver_param):
+                    raise
+                self.log("No TEST-phase net; training without a test net")
 
         seed = int(solver_param.random_seed)
         self.rng = jax.random.PRNGKey(seed if seed >= 0 else
@@ -124,6 +138,14 @@ class Solver:
         # iteration counter kept ON DEVICE: feeding a fresh host scalar
         # every step is a blocking H2D put; a resident counter is free
         self._it_dev = None
+
+    def smoothed_loss(self):
+        """Mean of the average_loss-window losses (one device fetch), or
+        None before any step — the value the display line prints."""
+        if not self._smoothed:
+            return None
+        return float(jnp.mean(jnp.stack(
+            [jnp.asarray(x) for x in self._smoothed])))
 
     def set_input_transform(self, fn, raw_overrides=None, test_fn=None):
         """Install on-device input transforms (before any step compiles).
@@ -305,8 +327,7 @@ class Solver:
                     self.watchdog.beat()
             if disp:
                 # ONE fetch for the whole smoothing window
-                sm = float(jnp.mean(jnp.stack(
-                    [jnp.asarray(x) for x in self._smoothed])))
+                sm = self.smoothed_loss()
                 if self.watchdog is not None:
                     self.watchdog.beat(sm)
                 lr = float(self.lr_fn(self.iter - 1))

@@ -8,8 +8,10 @@
                        "relpath label" lines -> Datum DB (optional resize,
                        gray, shuffle, encoded passthrough)
 
-All write LMDB via the pure-Python writer (data/lmdb.py); the reference's
-LevelDB option is intentionally not provided (see data/db_source.open_db).
+Both DB backends are pure-Python: LMDB (data/lmdb.py) is the default
+writer everywhere; convert_imageset also accepts backend="leveldb"
+(data/leveldb.py), and every reader goes through data/db_source.open_db,
+which reads either.
 """
 
 import os
@@ -106,13 +108,14 @@ def make_synth_cifar(out_dir, n_train=50000, n_test=10000, seed=0,
 
 def convert_imageset(root_folder, list_file, db_path, resize_height=0,
                      resize_width=0, gray=False, shuffle=False,
-                     encoded=False, seed=0, log=print):
+                     encoded=False, seed=0, backend="lmdb", log=print):
     """Images listed as "relative/path label" lines -> Datum DB.
 
     Matches tools/convert_imageset.cpp keys ("%08d_<path>") and flags
-    (--resize_height/width, --gray, --shuffle, --encoded). Undecodable
-    images are skipped with a warning, like the reference's
-    ReadImageToDatum false return (and ScaleAndConvert.scala:22-26)."""
+    (--resize_height/width, --gray, --shuffle, --encoded, --backend
+    lmdb/leveldb). Undecodable images are skipped with a warning, like the
+    reference's ReadImageToDatum false return (and
+    ScaleAndConvert.scala:22-26)."""
     from PIL import Image
 
     lines = []
@@ -127,8 +130,12 @@ def convert_imageset(root_folder, list_file, db_path, resize_height=0,
         np.random.RandomState(seed).shuffle(lines)
     log(f"A total of {len(lines)} images.")
 
+    if backend == "leveldb":
+        from .data.leveldb import LevelDBWriter as _Writer
+    else:
+        _Writer = LMDBWriter
     written = 0
-    with LMDBWriter(db_path) as w:
+    with _Writer(db_path) as w:
         for i, (rel, label) in enumerate(lines):
             full = os.path.join(root_folder, rel)
             try:
