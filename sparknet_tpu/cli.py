@@ -441,7 +441,12 @@ def cmd_lm(args):
     lm_kw = dict(vocab_size=args.vocab, seq_len=args.seq_len,
                  batch_size=args.batch, d_model=args.d_model,
                  num_heads=args.heads, flash=not args.no_flash)
-    dtype = jnp.bfloat16 if args.dtype == "bf16" else jnp.float32
+    # bf16 means MIXED precision: f32 master params (optimizer updates
+    # would underflow in bf16 — a d=1024 Adam run measurably stalls at the
+    # unigram plateau with bf16 masters), bf16 activations cast at the
+    # embedding so every matmul drives the MXU at full rate
+    compute_dtype = jnp.bfloat16 if args.dtype == "bf16" else None
+    dtype = jnp.float32
     stream, floor = lm_batch_stream(args.vocab, args.batch, args.seq_len,
                                     seed=args.seed)
     if metrics:
@@ -462,7 +467,8 @@ def cmd_lm(args):
             sp, mesh=make_mesh({"pipe": args.pipeline_stages}),
             num_layers=args.layers,
             num_microbatches=args.microbatches or None,
-            metrics=metrics, dtype=dtype, **lm_kw)
+            metrics=metrics, dtype=dtype, compute_dtype=compute_dtype,
+            **lm_kw)
         solver.snapshot_prefix = args.snapshot_prefix
         if args.resume:
             solver.restore(args.resume)
@@ -474,7 +480,8 @@ def cmd_lm(args):
         from .models import zoo
         net = zoo.transformer_lm(num_layers=args.layers,
                                  moe_experts=args.moe_experts, **lm_kw)
-        solver = Solver(sp, net_param=net, metrics=metrics, dtype=dtype)
+        solver = Solver(sp, net_param=net, metrics=metrics, dtype=dtype,
+                        compute_dtype=compute_dtype)
         if args.resume:
             solver.restore(args.resume)
         start_iter = solver.iter

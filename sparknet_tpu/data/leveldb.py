@@ -53,23 +53,9 @@ _COMPARATOR = b"leveldb.BytewiseComparator"
 
 
 # ---------------------------------------------------------------- varints
-
-def _put_varint(buf, v):
-    while v >= 0x80:
-        buf.append((v & 0x7f) | 0x80)
-        v >>= 7
-    buf.append(v)
-
-
-def _get_varint(data, p):
-    shift = result = 0
-    while True:
-        b = data[p]
-        p += 1
-        result |= (b & 0x7f) << shift
-        if not b & 0x80:
-            return result, p
-        shift += 7
+# shared LEB128 codec (the proto wire codec's — one implementation to fix)
+from ..proto.wire import _read_varint as _get_varint  # noqa: E402
+from ..proto.wire import _write_varint as _put_varint  # noqa: E402
 
 
 # ---------------------------------------------------------------- crc32c
@@ -504,6 +490,7 @@ class LevelDBReader:
                 if num >= log_number:
                     self._replay_wal(os.path.join(path, fn))
         self._decoded = None
+        self._cacheable = None
         self._len = None
 
     def _replay_wal(self, path):
@@ -532,11 +519,22 @@ class LevelDBReader:
         # table files are immutable, so decode each once and iterate the
         # cached version lists on every items() pass (a Datum source walks
         # the whole DB once per epoch; re-decompressing per pass would
-        # dominate the input pipeline)
-        if self._decoded is None:
+        # dominate the input pipeline). The cache is bounded: DBs whose
+        # table files exceed SPARKNET_LEVELDB_CACHE_MB (default 1024)
+        # re-decode per pass instead of pinning the dataset in host RAM.
+        if self._decoded is None and self._cacheable is None:
+            budget = float(os.environ.get("SPARKNET_LEVELDB_CACHE_MB",
+                                          "1024")) * (1 << 20)
+            self._cacheable = sum(
+                os.path.getsize(p) for p in self._tables) <= budget
+        if self._decoded is None and self._cacheable:
             self._decoded = [_table_versions(p, self.verify)
                              for p in self._tables]
-        srcs = [iter(t) for t in self._decoded]
+        if self._decoded is not None:
+            srcs = [iter(t) for t in self._decoded]
+        else:
+            srcs = [iter(_table_versions(p, self.verify))
+                    for p in self._tables]
         if self._memtable:
             srcs.append(iter(sorted(
                 (k, s, t, v) for k, (s, t, v) in self._memtable.items())))
@@ -577,6 +575,7 @@ class LevelDBReader:
         self._memtable = {}
         self._tables = []
         self._decoded = None
+        self._cacheable = None
 
     def __enter__(self):
         return self
@@ -622,7 +621,7 @@ class LevelDBWriter:
             tw = _TableWriter(f, self.block_size, self.compress)
             for k, s, v in versions:
                 tw.add(k + struct.pack("<Q", (s << 8) | _TYPE_VALUE), v)
-            size = tw.finish() if versions else self._empty_table(tw)
+            size = tw.finish()
         smallest = tw.first_key or b""
         largest = tw.last_key or b""
         last_seq = len(self._entries)
@@ -638,10 +637,6 @@ class LevelDBWriter:
             f.write("MANIFEST-000004\n")
         os.replace(tmp, os.path.join(self.path, "CURRENT"))
         self._entries = []
-
-    @staticmethod
-    def _empty_table(tw):
-        return tw.finish()
 
     def __enter__(self):
         return self

@@ -134,11 +134,18 @@ class CompiledNet:
     """
 
     def __init__(self, net_param, phase=TRAIN, feed_shapes=None,
-                 dtype=jnp.float32, level=0, stages=()):
+                 dtype=jnp.float32, level=0, stages=(), compute_dtype=None):
         from .upgrade import upgrade_net
         net_param = upgrade_net(net_param)
         self.phase = phase
         self.dtype = dtype
+        # mixed precision: params stay `dtype` (f32 masters for the
+        # optimizer), activations run `compute_dtype` (bf16 drives the
+        # MXU at full rate). Layers cast weights to their input's dtype,
+        # so the cast only needs to happen where activations are BORN
+        # from params alone — the embedding lookups (ops/dense.py Embed).
+        # Float feeds choose their own dtype at the batch boundary.
+        self.compute_dtype = compute_dtype
         self.net_param = filter_net(net_param, phase, level, stages)
         self.name = net_param.name
         feed_shapes = dict(feed_shapes or {})
@@ -178,6 +185,7 @@ class CompiledNet:
                 impl = cls(lp, bshapes, phase, feed_shapes=feed_shapes)
             else:
                 impl = cls(lp, bshapes, phase)
+            impl.compute_dtype = compute_dtype
             tshapes = impl.out_shapes()
             if len(tshapes) != len(tops):
                 raise ValueError(
@@ -284,6 +292,8 @@ class CompiledNet:
             train = (self.phase == TRAIN)
         if rng is None:
             rng = jax.random.PRNGKey(0)
+        from . import fission
+        fiss = fission.enabled()
         blobs = {}
         for n in self.net_inputs:
             blobs[n] = jnp.asarray(batch[n])
@@ -296,15 +306,22 @@ class CompiledNet:
             lparams = self.resolve_params(params, lp.name)
             bvals = [blobs[b] for b in bottoms]
             lrng = jax.random.fold_in(rng, li) if impl.needs_rng else None
-            if impl.has_state:
-                tvals, st = impl.apply_stateful(
-                    lparams, state[lp.name], bvals, train, lrng)
-                new_state[lp.name] = st
-            else:
-                tvals = impl.apply(lparams, bvals, train, lrng)
+            tvals = fission.try_apply(lp, impl, lparams, bvals,
+                                      train, lrng) if fiss else None
+            if tvals is None:
+                # normal path; any virtual concat bottom materializes here
+                bvals = [fission.materialize(v) for v in bvals]
+                if impl.has_state:
+                    tvals, st = impl.apply_stateful(
+                        lparams, state[lp.name], bvals, train, lrng)
+                    new_state[lp.name] = st
+                else:
+                    tvals = impl.apply(lparams, bvals, train, lrng)
             for t, v in zip(tops, tvals):
                 blobs[t] = v
-        return blobs, new_state
+        # callers see arrays only; unconsumed materializations are DCE'd
+        return {k: fission.materialize(v) for k, v in blobs.items()}, \
+            new_state
 
     def total_loss(self, blobs):
         """Weighted sum of loss tops (reference net.cpp ForwardFromTo loss

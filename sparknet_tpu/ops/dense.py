@@ -74,6 +74,12 @@ class Embed(Layer):
         y = jnp.take(params[0], idx, axis=0)
         if self.bias_term:
             y = y + params[1]
+        cd = getattr(self, "compute_dtype", None)
+        if cd is not None:
+            # activations are born here from params alone: this cast is
+            # what puts the whole downstream transformer in bf16 while
+            # the embedding table itself stays an f32 master
+            y = y.astype(cd)
         return [y]
 
 

@@ -259,7 +259,12 @@ class LocalSGDSolver(Solver):
             state = jax.lax.pmean(state, axis)
             if average_history:
                 history = jax.lax.pmean(history, axis)
-            return params, state, history, jnp.mean(losses)
+            # the round loss is the mean over ALL workers' tau steps —
+            # without the pmean the P() out_spec would hand back whichever
+            # worker's mean sits on the fetching host's first device
+            # (observably different across hosts/modes)
+            return params, state, history, jax.lax.pmean(jnp.mean(losses),
+                                                         axis)
 
         bspec = _batch_specs(batch_example, axis, batch_dim=1)
         with context.axis_context(data=axis):

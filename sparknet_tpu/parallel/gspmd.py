@@ -102,8 +102,16 @@ class GSPMDSolver(Solver):
                                   self._batch_sh, rep, rep),
                     out_shardings=(ps_tree, state_sh, hist_sh, rep, rep),
                     donate_argnums=(0, 1, 2))
-            batch = {k: jax.device_put(np.asarray(v), self._batch_sh[k])
-                     for k, v in batch.items()}
+            if jax.process_count() > 1:
+                # each host holds only ITS slice of the batch axis; the
+                # global array assembles from per-host shards (same
+                # mechanism as data_parallel.shard_batch)
+                batch = {k: jax.make_array_from_process_local_data(
+                             self._batch_sh[k], np.asarray(v))
+                         for k, v in batch.items()}
+            else:
+                batch = {k: jax.device_put(np.asarray(v), self._batch_sh[k])
+                         for k, v in batch.items()}
             return self._jit(params, state, history, batch, it, rng)
 
         return stepped

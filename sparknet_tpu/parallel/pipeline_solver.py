@@ -56,7 +56,8 @@ class PipelineLMSolver:
 
     def __init__(self, solver_param, mesh=None, num_layers=4,
                  num_microbatches=None, axis="pipe", dtype=jnp.float32,
-                 log_fn=print, metrics=None, **lm_kwargs):
+                 log_fn=print, metrics=None, compute_dtype=None,
+                 **lm_kwargs):
         from ..models import zoo
         self.param = solver_param
         self.log = log_fn or (lambda *a: None)
@@ -74,8 +75,10 @@ class PipelineLMSolver:
         self.num_microbatches = num_microbatches or max(2 * S, 1)
         prefix_np, block_np, suffix_np = zoo.transformer_lm_pieces(
             **lm_kwargs)
-        self.prefix = CompiledNet(prefix_np, TRAIN, dtype=dtype)
-        self.suffix = CompiledNet(suffix_np, TRAIN, dtype=dtype)
+        self.prefix = CompiledNet(prefix_np, TRAIN, dtype=dtype,
+                                  compute_dtype=compute_dtype)
+        self.suffix = CompiledNet(suffix_np, TRAIN, dtype=dtype,
+                                  compute_dtype=compute_dtype)
         self.batch_size, self.seq_len = self.prefix.feed_shapes()["data"]
         if self.batch_size % self.num_microbatches:
             raise ValueError(
@@ -86,7 +89,7 @@ class PipelineLMSolver:
         mb = self.batch_size // self.num_microbatches
         d_model = self.suffix.feed_shapes()["x"][2]
         self.block = CompiledNet(
-            block_np, TRAIN, dtype=dtype,
+            block_np, TRAIN, dtype=dtype, compute_dtype=compute_dtype,
             feed_shapes={"x": (mb, self.seq_len, d_model)})
 
         seed = int(solver_param.random_seed)

@@ -69,7 +69,7 @@ class Solver:
 
     def __init__(self, solver_param, net_param=None, feed_shapes=None,
                  test_feed_shapes=None, base_dir="", dtype=jnp.float32,
-                 log_fn=print, metrics=None):
+                 log_fn=print, metrics=None, compute_dtype=None):
         self.param = solver_param
         self.log = log_fn or (lambda *a: None)
         # structured observability hooks, armed by default from the CLI:
@@ -82,13 +82,14 @@ class Solver:
         self.watchdog = None
         train_np, test_np = resolve_nets(solver_param, base_dir, net_param)
         self.net = CompiledNet(train_np, TRAIN, feed_shapes=feed_shapes,
-                               dtype=dtype)
+                               dtype=dtype, compute_dtype=compute_dtype)
         self.test_net = None
         if test_np is not None:
             try:
                 self.test_net = CompiledNet(
                     test_np, TEST,
-                    feed_shapes=test_feed_shapes or feed_shapes, dtype=dtype)
+                    feed_shapes=test_feed_shapes or feed_shapes, dtype=dtype,
+                    compute_dtype=compute_dtype)
             except ValueError:
                 # a shared `net` whose data layer is TRAIN-only has no
                 # TEST-phase graph; without a test_iter schedule the

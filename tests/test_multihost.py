@@ -59,6 +59,170 @@ def _free_port():
     return port
 
 
+_WORKER2 = r"""
+import os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+import jax
+jax.config.update("jax_platforms", "cpu")
+pid = int(sys.argv[1]); port = sys.argv[2]
+jax.distributed.initialize(f"localhost:{port}", num_processes=2,
+                           process_id=pid)
+import numpy as np
+sys.path.insert(0, %(repo)r)
+from sparknet_tpu.proto import Message
+from sparknet_tpu.models import zoo
+from sparknet_tpu.parallel import (make_mesh, LocalSGDSolver, GSPMDSolver,
+                                   DataParallelSolver)
+
+GLOBAL_BATCH, TAU = 16, 2
+half = GLOBAL_BATCH // 2
+
+# --- 1. the SparkNet algorithm across hosts: tau-step local SGD rounds ---
+# (lr kept small: per-worker batch is 2, and a diverging trajectory would
+# amplify cross-process float-reduction-order noise past any tolerance)
+sp = Message("SolverParameter", base_lr=0.005, lr_policy="fixed",
+             momentum=0.9, display=0, random_seed=0)
+# local-SGD nets are built at the PER-WORKER batch (global/8), like the
+# reference gives each Caffe worker its own small-batch net
+solver = LocalSGDSolver(sp, mesh=make_mesh({"data": 8}), tau=TAU,
+                        net_param=zoo.lenet(batch_size=GLOBAL_BATCH // 8))
+rs = np.random.RandomState(0)
+losses = []
+for rnd in range(2):
+    data = rs.randn(TAU, GLOBAL_BATCH, 1, 28, 28).astype(np.float32)
+    label = rs.randint(0, 10, (TAU, GLOBAL_BATCH))
+    # this host's slice of the round's batches (batch axis = dim 1)
+    loss = solver.train_round(
+        {"data": data[:, pid * half:(pid + 1) * half],
+         "label": label[:, pid * half:(pid + 1) * half]})
+    losses.append(float(loss))
+print("SGD_LOSSES", pid, " ".join(f"{v:.6f}" for v in losses), flush=True)
+# post-round params must be identical across hosts (the averaging
+# collective IS the cross-host agreement)
+tot = sum(float(np.abs(np.asarray(b)).sum())
+          for bs in solver.params.values() for b in bs)
+print("SGD_PARAM_SUM", pid, f"{tot:.6f}", flush=True)
+
+# --- 2. GSPMD (dp x tp sharding annotations) across hosts ---
+sp2 = Message("SolverParameter", base_lr=0.05, lr_policy="fixed",
+              momentum=0.9, display=0, random_seed=0)
+gs = GSPMDSolver(sp2, mesh=make_mesh({"data": 4, "model": 2}),
+                 net_param=zoo.lenet(batch_size=GLOBAL_BATCH))
+rs = np.random.RandomState(1)
+glosses = []
+for step in range(3):
+    data = rs.randn(GLOBAL_BATCH, 1, 28, 28).astype(np.float32)
+    label = rs.randint(0, 10, GLOBAL_BATCH)
+    loss = gs.train_step({"data": data[pid * half:(pid + 1) * half],
+                          "label": label[pid * half:(pid + 1) * half]})
+    glosses.append(float(loss))
+print("GSPMD_LOSSES", pid, " ".join(f"{v:.6f}" for v in glosses), flush=True)
+
+# --- 3. check_batch rejects a wrong-size host slice with a clear error ---
+sp3 = Message("SolverParameter", base_lr=0.05, lr_policy="fixed",
+              display=0, random_seed=0)
+dp = DataParallelSolver(sp3, mesh=make_mesh({"data": 8}),
+                        net_param=zoo.lenet(batch_size=GLOBAL_BATCH))
+try:
+    # feeding the FULL global batch instead of this host's half
+    dp.train_step({"data": np.zeros((GLOBAL_BATCH, 1, 28, 28), np.float32),
+                   "label": np.zeros(GLOBAL_BATCH, np.int64)})
+    print("CHECKBATCH", pid, "NO_ERROR", flush=True)
+except ValueError as e:
+    msg = str(e)
+    ok = "data" in msg and "slice" in msg and "(8," in msg
+    print("CHECKBATCH", pid, "OK" if ok else "BAD_MSG:" + repr(msg),
+          flush=True)
+"""
+
+
+def _run_workers(script_text, tmp_path, n=2, timeout=900):
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = tmp_path / "worker.py"
+    script.write_text(script_text % {"repo": repo})
+    port = _free_port()
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
+    procs = [subprocess.Popen([sys.executable, str(script), str(i),
+                               str(port)],
+                              stdout=subprocess.PIPE,
+                              stderr=subprocess.PIPE, text=True, env=env)
+             for i in range(n)]
+    outs = []
+    for p in procs:
+        out, err = p.communicate(timeout=timeout)
+        assert p.returncode == 0, f"worker failed:\n{out}\n{err}"
+        outs.append(out)
+    return outs
+
+
+def _collect(outs, tag):
+    per = {}
+    for out in outs:
+        for line in out.splitlines():
+            if line.startswith(tag + " "):
+                parts = line.split()
+                per[int(parts[1])] = parts[2:]
+    assert set(per) == {0, 1}, f"{tag}: missing a process: {per}"
+    return per
+
+
+@pytest.fixture(scope="module")
+def strategy_outs(tmp_path_factory):
+    """One 2-process run exercising LocalSGD, GSPMD and the check_batch
+    error path (jax.distributed setup is ~20 s; share it)."""
+    return _run_workers(_WORKER2, tmp_path_factory.mktemp("mh"))
+
+
+def test_two_process_local_sgd_round(strategy_outs):
+    """tau-step local SGD across 2 real processes: both hosts see the same
+    round losses AND identical post-averaging params — the cross-host
+    version of the algorithm the reference runs over Spark
+    (CifarApp.scala:92-135)."""
+    per = _collect(strategy_outs, "SGD_LOSSES")
+    np.testing.assert_allclose([float(v) for v in per[0]],
+                               [float(v) for v in per[1]], rtol=1e-5)
+    sums = _collect(strategy_outs, "SGD_PARAM_SUM")
+    assert abs(float(sums[0][0]) - float(sums[1][0])) < 1e-3
+
+    # and the 2-host trajectory matches the same run done single-process
+    # (same 8-slot mesh, same global batches)
+    from sparknet_tpu.proto import Message
+    from sparknet_tpu.models import zoo
+    from sparknet_tpu.parallel import make_mesh, LocalSGDSolver
+    sp = Message("SolverParameter", base_lr=0.005, lr_policy="fixed",
+                 momentum=0.9, display=0, random_seed=0)
+    solver = LocalSGDSolver(sp, mesh=make_mesh({"data": 8}), tau=2,
+                            net_param=zoo.lenet(batch_size=2))
+    rs = np.random.RandomState(0)
+    ref = []
+    for rnd in range(2):
+        data = rs.randn(2, 16, 1, 28, 28).astype(np.float32)
+        label = rs.randint(0, 10, (2, 16))
+        ref.append(float(solver.train_round({"data": data,
+                                             "label": label})))
+    np.testing.assert_allclose([float(v) for v in per[0]], ref,
+                               rtol=1e-3, atol=1e-4)
+
+
+def test_two_process_gspmd_step(strategy_outs):
+    """GSPMD (dp=4 x tp=2 annotations, XLA SPMD partitioner) across 2 real
+    processes: both hosts agree on every step loss."""
+    per = _collect(strategy_outs, "GSPMD_LOSSES")
+    assert len(per[0]) == 3
+    np.testing.assert_allclose([float(v) for v in per[0]],
+                               [float(v) for v in per[1]], rtol=1e-5)
+
+
+def test_two_process_check_batch_error(strategy_outs):
+    """Feeding a full global batch where a host slice belongs fails fast
+    with the blob name and the expected per-host shape."""
+    per = _collect(strategy_outs, "CHECKBATCH")
+    assert per[0][0] == "OK", per[0]
+    assert per[1][0] == "OK", per[1]
+
+
 def test_two_process_dp_matches_single_process(tmp_path):
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     script = tmp_path / "worker.py"
