@@ -156,9 +156,16 @@ def bench_hostfed(name, net_param, batch_size, src_size, crop, classes,
     host_t = DataTransformer(tp, phase=0, rng=np.random.RandomState(1))
     devt = DeviceTransformer(host_t)
     rec_shape = (3, src_size, src_size)
+    base_fn = devt.device_fn()
+
+    def tf(b):
+        # match the synthetic row's activation dtype (bf16) so the two
+        # rows isolate the input pipeline, not a compute-dtype change
+        b = base_fn(b)
+        b["data"] = b["data"].astype(jnp.bfloat16)
+        return b
     solver.set_input_transform(
-        devt.device_fn(),
-        raw_overrides=devt.raw_overrides(batch_size, rec_shape))
+        tf, raw_overrides=devt.raw_overrides(batch_size, rec_shape))
 
     rs = np.random.RandomState(0)
     pool = rs.randint(0, 256, (batch_size * 2, 3, src_size, src_size),
@@ -213,6 +220,7 @@ def bench_hostfed(name, net_param, batch_size, src_size, crop, classes,
            "images_per_sec_spread": _rate_stats(batch_size * ITERS, dts),
            "h2d_kb_per_image": round(int(np.prod(rec_shape)) / 1024, 1),
            "transfer_only_images_per_sec": round(transfer_img_s, 2),
+           "transfer_only_spread": _rate_stats(batch_size * 5, t_dts),
            "device_step_images_per_sec": round(step_img_s, 2)}
     if peak:
         row["mfu"] = round(img_s * flops / peak, 4)

@@ -337,11 +337,11 @@ def cmd_extract_features(args):
     from . import tools
     blobs = args.blobs.split(",")
     dbs = args.dbs.split(",")
-    if args.db_type != "lmdb":
-        raise SystemExit("only the lmdb backend is supported "
-                         "(see data/db_source.open_db)")
+    if args.db_type not in ("lmdb", "leveldb"):
+        raise SystemExit(f"unknown db_type {args.db_type!r}")
     tools.extract_features(args.model, blobs, dbs, args.num_batches,
-                           weights_path=args.weights)
+                           weights_path=args.weights,
+                           backend=args.db_type)
     return 0
 
 

@@ -203,7 +203,8 @@ def upgrade_solver_proto(in_path, out_path, log=print):
 
 
 def extract_features(model_path, blob_names, db_paths, num_batches,
-                     weights_path=None, base_dir=None, log=print):
+                     weights_path=None, base_dir=None, backend="lmdb",
+                     log=print):
     """Forward a TEST-phase net num_batches times and write the named
     blobs' per-image activations as float Datums, keys "%010d"
     (tools/extract_features.cpp:135-185; Datum channels/height/width
@@ -254,7 +255,11 @@ def extract_features(model_path, blob_names, db_paths, num_batches,
             return {b: blobs[b] for b in blob_names}
 
         log("Extracting Features")
-        writers = [LMDBWriter(p) for p in db_paths]
+        if backend == "leveldb":
+            from .data.leveldb import LevelDBWriter as _W
+        else:
+            _W = LMDBWriter
+        writers = [_W(p) for p in db_paths]
         counts = [0] * len(blob_names)
         try:
             it = iter(src)

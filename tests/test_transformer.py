@@ -176,3 +176,48 @@ def test_lm_flash_matches_dense():
     la, _ = net_d.loss_fn(params, state, batch, jax.random.PRNGKey(1))
     lb, _ = net_f.loss_fn(params, state, batch, jax.random.PRNGKey(1))
     assert abs(float(la) - float(lb)) < 1e-3
+
+
+class TestMixedPrecision:
+    """compute_dtype (graph/compiler.py): f32 master params, activations
+    cast to bf16 at the embedding — the knob the LM path needs because
+    int32 tokens can't carry the compute dtype in from the feed."""
+
+    def _net(self):
+        from sparknet_tpu.models import zoo
+        return zoo.transformer_lm(vocab_size=64, seq_len=16, batch_size=2,
+                                  d_model=32, num_layers=1, num_heads=2,
+                                  flash=False)
+
+    def test_activations_bf16_params_f32(self):
+        import jax
+        import jax.numpy as jnp
+        from sparknet_tpu.graph.compiler import CompiledNet, TRAIN
+        net = CompiledNet(self._net(), TRAIN, compute_dtype=jnp.bfloat16)
+        params, state = net.init(jax.random.PRNGKey(0))
+        assert params["tok_embed"][0].dtype == jnp.float32
+        batch = {"data": np.zeros((2, 16), np.int32),
+                 "label": np.zeros((2, 16), np.int32)}
+        blobs, _ = net.apply(params, state, batch)
+        assert blobs["embed"].dtype == jnp.bfloat16          # cast point
+        assert blobs["block0/res2"].dtype == jnp.bfloat16    # stays bf16
+        # loss still accumulates f32
+        loss, _ = net.loss_fn(params, state, batch)
+        assert loss.dtype == jnp.float32
+
+    def test_train_step_keeps_f32_masters(self):
+        import jax.numpy as jnp
+        from sparknet_tpu.proto import Message
+        from sparknet_tpu.solver.solver import Solver
+        sp = Message("SolverParameter", base_lr=0.1, lr_policy="fixed",
+                     display=0, random_seed=0, type="Adam")
+        s = Solver(sp, net_param=self._net(),
+                   compute_dtype=jnp.bfloat16)
+        rs = np.random.RandomState(0)
+        batch = {"data": rs.randint(0, 64, (2, 16)),
+                 "label": rs.randint(0, 64, (2, 16))}
+        l0 = float(s.train_step(batch))
+        for _ in range(20):
+            loss = s.train_step(batch)
+        assert s.params["tok_embed"][0].dtype == jnp.float32
+        assert float(loss) < l0       # actually learns (no bf16 stall)
