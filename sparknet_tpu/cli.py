@@ -135,10 +135,11 @@ def cmd_train(args):
         from .data.device_cache import maybe_device_cache
         budget = float(os.environ.get("SPARKNET_DEVICE_CACHE_MB", "2048"))
         if budget > 0:
-            train_src = maybe_device_cache(train_src, budget)
+            isz = int(sp.iter_size)
+            train_src = maybe_device_cache(train_src, budget, iter_size=isz)
             if hasattr(train_src, "nbytes"):     # budget is SHARED
                 budget -= train_src.nbytes / (1 << 20)
-            test_src = maybe_device_cache(test_src, budget)
+            test_src = maybe_device_cache(test_src, budget, iter_size=isz)
     feed = {**(train_shapes or {}), **_feed_shapes_arg(args.input_shape)}
 
     if args.strategy == "dp":

@@ -54,6 +54,8 @@ class DeviceCachedSource:
         self.shape = dbsource.shape
         self._devt = dbsource._devt
         self._ctl_key = f"{self.data_top}#ctl"
+        self._img_key = f"{self.data_top}#cacheimg"
+        self._lab_key = f"{self.data_top}#cachelab"
 
         n = len(dbsource.db)
         labels = np.empty(n, np.int32)
@@ -64,8 +66,23 @@ class DeviceCachedSource:
                 arrs = np.empty((n,) + self.record_shape, arr.dtype)
             arrs[i] = arr.reshape(self.record_shape)
         self.num_records = n
-        # one bulk H2D each; steady-state steps transfer ~nothing
-        self._images = jax.device_put(arrs, device)
+        # bulk H2D once; steady-state steps transfer ~nothing. The upload
+        # goes up in bounded chunks rather than one giant device_put: a
+        # multi-hundred-MB single RPC is exactly what flaky host->device
+        # links (observed: the remote tunnel) hang on, and chunking also
+        # bounds peak host pinned memory on real hardware.
+        import os
+        chunk_mb = float(os.environ.get("SPARKNET_CACHE_CHUNK_MB", "32"))
+        rec_bytes = int(np.prod(self.record_shape)) * arrs.itemsize + 4
+        per = max(1, int(chunk_mb * (1 << 20)) // rec_bytes)
+        if n > per:
+            import jax.numpy as jnp
+            parts = [jax.device_put(arrs[s0:s0 + per], device)
+                     for s0 in range(0, n, per)]
+            self._images = jnp.concatenate(parts, axis=0)
+            del parts              # transient 2x HBM only during assembly
+        else:
+            self._images = jax.device_put(arrs, device)
         self._labels = jax.device_put(labels, device)
         self._start = dbsource._skip % n
         dbsource.db.close()
@@ -94,7 +111,15 @@ class DeviceCachedSource:
     def __iter__(self):
         """Infinite per-step control stream: sequential cursor + the host
         rng's crop/mirror draws (same rng, same order as the streaming
-        device mode — the augmentation stream is identical)."""
+        device mode — the augmentation stream is identical).
+
+        The resident arrays ride along in every batch dict as ARGUMENTS to
+        the jitted step rather than closure constants: an already-on-device
+        array costs nothing to pass, while a closed-over multi-hundred-MB
+        constant gets embedded into the HLO where XLA's constant handling
+        can stall compilation for tens of minutes (observed on the 383 MB
+        imagenet-shaped cache; the 150 MB CIFAR cache merely compiled
+        slowly)."""
         n, b = self.num_records, self.batch_size
         pos = self._start
         self._start = 0
@@ -108,16 +133,19 @@ class DeviceCachedSource:
                 cols += [aux[ky], aux[kx]]
             if kf in aux:
                 cols.append(aux[kf].astype(np.int32))
-            yield {self._ctl_key: np.stack(cols, axis=1)}
+            yield {self._ctl_key: np.stack(cols, axis=1),
+                   self._img_key: self._images,
+                   self._lab_key: self._labels}
 
     @property
     def device_fn(self):
         """fn(batch)->batch for Solver.set_input_transform: unpack the ctl
-        array, gather the resident records, transform on-device."""
+        array, gather the resident records (arriving as batch entries, see
+        __iter__), transform on-device."""
         import jax.numpy as jnp
         t = self._devt.h
-        images, labels = self._images, self._labels
-        ctl_key = self._ctl_key
+        ctl_key, img_key, lab_key = \
+            self._ctl_key, self._img_key, self._lab_key
         data_top, label_top = self.data_top, self.label_top
         ky, kx, kf = self._devt.ky, self._devt.kx, self._devt.kf
         has_crop, has_flip = bool(t.crop_size), bool(t.mirror)
@@ -126,6 +154,8 @@ class DeviceCachedSource:
         def fn(batch):
             batch = dict(batch)
             ctl = batch.pop(ctl_key)
+            images = batch.pop(img_key)
+            labels = batch.pop(lab_key)
             idx = ctl[:, 0]
             feed = {data_top: jnp.take(images, idx, axis=0),
                     label_top: jnp.take(labels, idx, axis=0)}
@@ -143,23 +173,37 @@ class DeviceCachedSource:
 
     @property
     def raw_feed_overrides(self):
-        """check_batch overrides: the ctl array is the ONLY host feed; the
-        data/label blobs come from the resident arrays (None = not fed)."""
+        """check_batch overrides: the tiny ctl array plus the (free,
+        already-resident) cache arrays; the net's data/label blobs are
+        produced on-device (None = not host-fed)."""
         over = {self.data_top: None, self.label_top: None,
-                self._ctl_key: (self.batch_size, self._ctl_columns())}
+                self._ctl_key: (self.batch_size, self._ctl_columns()),
+                self._img_key: (self.num_records,) + self.record_shape,
+                self._lab_key: (self.num_records,)}
         return over
 
     def close(self):
         self._images = self._labels = None
 
 
-def maybe_device_cache(src, budget_mb=2048):
+def maybe_device_cache(src, budget_mb=2048, iter_size=1):
     """Promote a device-mode DatumBatchSource to a DeviceCachedSource when
     the whole dataset fits the HBM budget; otherwise return it unchanged
-    (the streaming device-transform path still applies)."""
+    (the streaming device-transform path still applies).
+
+    Refuses under iter_size > 1 (Solver.step stacks micro-batch dicts on
+    the HOST, which would read the resident arrays back and re-upload
+    iter_size copies per step) and under multi-process JAX (the resident
+    arrays are whole-dataset, not per-host batch slices, so the per-host
+    check_batch slicing rule doesn't apply to them)."""
     if src is None or not getattr(src, "device_mode", False):
         return src
     if not hasattr(src, "db"):
+        return src
+    if int(iter_size) > 1:
+        return src
+    import jax
+    if jax.process_count() > 1:
         return src
     # size from the first record's ACTUAL dtype — float_data datums decode
     # to float32, 4x the uint8 pixel estimate

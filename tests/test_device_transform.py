@@ -231,3 +231,38 @@ def test_check_batch_raw_overrides_errors():
     bad = dict(good, data=np.zeros((4, 3, 24, 24), np.float32))
     with pytest.raises(ValueError, match="data"):
         s.check_batch(bad)                         # cropped shape rejected
+
+
+def test_device_cache_chunked_upload_matches(tmp_path, monkeypatch):
+    """SPARKNET_CACHE_CHUNK_MB: a tiny chunk size forces the multi-part
+    upload + on-device concatenate path; resident contents must be
+    identical to the single-put path."""
+    from sparknet_tpu.data.db_source import DatumBatchSource
+    from sparknet_tpu.data.device_cache import DeviceCachedSource
+    imgs, labels = _make_lmdb(str(tmp_path / "db"))
+
+    def mk():
+        return DatumBatchSource(str(tmp_path / "db"), 16, seed=3,
+                                device_transform=True)
+
+    monkeypatch.setenv("SPARKNET_CACHE_CHUNK_MB", "0.002")  # ~1 record
+    chunked = DeviceCachedSource(mk())
+    monkeypatch.setenv("SPARKNET_CACHE_CHUNK_MB", "1024")
+    single = DeviceCachedSource(mk())
+    np.testing.assert_array_equal(np.asarray(chunked._images),
+                                  np.asarray(single._images))
+    np.testing.assert_array_equal(np.asarray(chunked._labels),
+                                  np.asarray(single._labels))
+
+
+def test_device_cache_gates(tmp_path):
+    """The cache is a single-process, iter_size==1 optimization: iter_size
+    > 1 would stack resident arrays on the host per micro-batch, and
+    multi-process check_batch slicing doesn't apply to whole-dataset
+    resident arrays — both must fall back to the streaming source."""
+    from sparknet_tpu.data.db_source import DatumBatchSource
+    from sparknet_tpu.data.device_cache import maybe_device_cache
+    _make_lmdb(str(tmp_path / "db"))
+    src = DatumBatchSource(str(tmp_path / "db"), 16, device_transform=True)
+    assert maybe_device_cache(src, iter_size=4) is src
+    assert maybe_device_cache(src, iter_size=1) is not src
