@@ -1,0 +1,22 @@
+#!/bin/bash
+# Local-SGD vs DP plateau sweep (VERDICT r3 item 1). Sequential on purpose:
+# the box has one core; parallel runs would just contend. Headline pair
+# first so partial results are already meaningful.
+cd "$(dirname "$0")/.."
+mkdir -p results _work
+P=experiments/plateau_cifar.py
+L=_work/plateau
+mkdir -p $L
+run() {
+    name=$1; shift
+    echo "=== $name: $* ==="
+    python $P "$@" --metrics results/plateau_${name}.jsonl \
+        > $L/${name}.log 2>&1
+    echo "=== $name done rc=$? ==="
+}
+run t10_w4 --strategy local_sgd --tau 10 --workers 4
+run dp_w4  --strategy dp --workers 4
+run t50_w4 --strategy local_sgd --tau 50 --workers 4
+run t10_w8 --strategy local_sgd --tau 10 --workers 8
+run t1_w4  --strategy local_sgd --tau 1 --workers 4 --max-images 800000
+echo "SWEEP COMPLETE"

@@ -126,6 +126,13 @@ class CifarApp:
             if self.strategy == "local_sgd" \
             else self.solver.net.feed_shapes()["data"][0]
 
+    def run_test(self, max_iters=100):
+        """Full test pass -> {score_name: float mean} (CifarApp.scala:98)."""
+        n = min(len(self.data.test_images) // self._test_batch_size(),
+                max_iters)
+        scores = self.solver.test(self._test_iter(), num_iters=n)
+        return {k: float(np.asarray(v).mean()) for k, v in scores.items()}
+
     def _test_iter(self):
         imgs = self.data.test_images.astype(np.float32) - self.data.mean_image
         labs = self.data.test_labels
@@ -166,16 +173,12 @@ class CifarApp:
                 for r in range(num_rounds):
                     if r % test_every == 0:
                         self.log("testing")
-                        n = min(len(self.data.test_images)
-                                // self._test_batch_size(), 100)
-                        scores = self.solver.test(self._test_iter(),
-                                                  num_iters=n)
-                        for k, v in scores.items():
-                            v = float(np.asarray(v).mean())
+                        for k, v in self.run_test().items():
                             self.log(f"round {r}: test {k} = {v:.4f}")
                             if metrics:
                                 metrics.log("test", round=r, metric=k,
                                             value=v)
+
                     self.log("broadcasting weights & running workers")
                     rt0 = time.perf_counter()
                     if self.strategy == "local_sgd":
