@@ -71,14 +71,19 @@ def main():
     app = CifarApp(num_workers=args.workers, data_dir=args.data,
                    strategy=args.strategy, tau=args.tau, seed=args.seed)
     solver = app.solver
+    if os.path.exists(args.metrics):
+        # MetricsLogger appends; a stale series under the same path would
+        # interleave two runs into one unreadable curve
+        os.rename(args.metrics, args.metrics + ".old")
     metrics = MetricsLogger(path=args.metrics)
 
     steps_per_round = args.tau if args.strategy == "local_sgd" else 1
     imgs_per_round = TRAIN_BATCH * app.num_workers * steps_per_round
     param_bytes = sum(np.prod(v.shape) * v.dtype.itemsize
                       for v in jax.tree_util.tree_leaves(solver.params))
-    # allreduce events so far: DP one per step, local SGD one per round
-    events_per_round = steps_per_round if args.strategy == "dp" else 1
+    # one allreduce per round in both strategies; a DP "round" is one step
+    # (gradient pmean), a local-SGD round is tau steps (weight pmean)
+    events_per_round = 1
     app.log(f"plateau driver: {args.strategy} tau={args.tau} "
             f"workers={app.num_workers} imgs/round={imgs_per_round} "
             f"test every {args.test_every_images} images "

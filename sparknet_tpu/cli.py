@@ -308,7 +308,10 @@ def cmd_make_synth_cifar(args):
 
 def cmd_compute_mean(args):
     from . import tools
-    tools.compute_image_mean(args.db, args.output)
+    # backend=None -> open_db sniffs the on-disk layout, so LevelDB dirs
+    # from `convert_imageset --backend leveldb` work like the reference
+    # tool's -backend flag (compute_image_mean.cpp:22)
+    tools.compute_image_mean(args.db, args.output, backend=args.backend)
     return 0
 
 
@@ -340,8 +343,9 @@ def cmd_extract_features(args):
     dbs = args.dbs.split(",")
     if args.db_type not in ("lmdb", "leveldb"):
         raise SystemExit(f"unknown db_type {args.db_type!r}")
+    weights = None if args.weights.lower() == "none" else args.weights
     tools.extract_features(args.model, blobs, dbs, args.num_batches,
-                           weights_path=args.weights,
+                           weights_path=weights,
                            backend=args.db_type)
     return 0
 
@@ -600,6 +604,8 @@ def main(argv=None):
                         help="Datum DB -> mean image .binaryproto")
     cm.add_argument("db")
     cm.add_argument("output")
+    cm.add_argument("--backend", choices=("lmdb", "leveldb"), default=None,
+                    help="DB backend (default: sniff the directory layout)")
     cm.set_defaults(fn=cmd_compute_mean)
 
     ci = sub.add_parser("convert_imageset",
@@ -632,14 +638,13 @@ def main(argv=None):
 
     ef = sub.add_parser("extract_features",
                         help="forward a net, write named blobs as "
-                             "float-Datum LMDBs (reference binary order is "
-                             "`weights model blobs dbs n [db_type]`; here "
-                             "weights moved to --weights so it can be "
-                             "omitted for random-init runs)")
-    ef.add_argument("--weights", default=None,
-                    help=".caffemodel — the reference's FIRST positional "
-                         "(pretrained_net_param); optional here: random "
-                         "init if absent")
+                             "float-Datum DBs — positional order matches "
+                             "the reference binary "
+                             "(tools/extract_features.cpp): "
+                             "weights model blobs dbs n [db_type]")
+    ef.add_argument("weights",
+                    help=".caffemodel (the reference's pretrained_net_param "
+                         "first positional); pass `none` for random init")
     ef.add_argument("model", help="feature-extraction prototxt with a "
                                   "TEST data layer")
     ef.add_argument("blobs", help="blob_name1[,name2,...]")

@@ -23,9 +23,19 @@ construction. TEST passes restart from record 0 (fresh `iter()` per test,
 as the CLI has always done).
 """
 
+import os
+
 import numpy as np
 
 from .datum import datum_to_array
+
+
+def _chunk_bytes():
+    """Upload chunk size — the ONE definition shared by the uploader and
+    maybe_device_cache's 2x-headroom gate, so the gate's single-put-vs-
+    chunked decision always matches the path actually taken."""
+    return int(float(os.environ.get("SPARKNET_CACHE_CHUNK_MB", "32"))
+               * (1 << 20))
 
 
 class DeviceCachedSource:
@@ -71,10 +81,8 @@ class DeviceCachedSource:
         # multi-hundred-MB single RPC is exactly what flaky host->device
         # links (observed: the remote tunnel) hang on, and chunking also
         # bounds peak host pinned memory on real hardware.
-        import os
-        chunk_mb = float(os.environ.get("SPARKNET_CACHE_CHUNK_MB", "32"))
         rec_bytes = int(np.prod(self.record_shape)) * arrs.itemsize + 4
-        per = max(1, int(chunk_mb * (1 << 20)) // rec_bytes)
+        per = max(1, _chunk_bytes() // rec_bytes)
         if n > per:
             import jax.numpy as jnp
             parts = [jax.device_put(arrs[s0:s0 + per], device)
@@ -209,6 +217,11 @@ def maybe_device_cache(src, budget_mb=2048, iter_size=1):
     # to float32, 4x the uint8 pixel estimate
     arr, _ = datum_to_array(next(src.db.items())[1])
     est = len(src.db) * (arr.size * arr.itemsize + 4)
-    if est > budget_mb * (1 << 20):
+    # the chunked upload path (datasets > one chunk) transiently holds
+    # parts + their concatenation in HBM, so gate on ~2x for it — a
+    # dataset near the budget must not OOM where a single device_put
+    # would have fit
+    needed = est * 2 if est > _chunk_bytes() else est
+    if needed > budget_mb * (1 << 20):
         return src
     return DeviceCachedSource(src)

@@ -383,7 +383,13 @@ class _TableWriter:
 
 def _decode_version_edit(rec):
     """VersionEdit tags we act on: 2 log_number, 6 deleted file,
-    7 new file; the rest are parsed and skipped."""
+    7 new file; the other standard tags (1,3,4,5,9) are parsed and
+    skipped. Truly unknown tags raise: varint-framed records can't be
+    skipped without knowing their field structure, and guessing would
+    silently corrupt every later field in the edit — matching leveldb's
+    own VersionEdit::DecodeFrom, which also rejects unknown tags
+    (version_edit.cc). Notably tag 8 (kLargeValueRef, removed pre-1.0)
+    is rejected here just as it is upstream."""
     p = 0
     out = {"new": [], "deleted": [], "log_number": None}
     while p < len(rec):
@@ -417,7 +423,10 @@ def _decode_version_edit(rec):
             p += n                       # largest internal key
             out["new"].append((level, num))
         else:
-            raise ValueError(f"unknown VersionEdit tag {tag}")
+            raise ValueError(
+                f"unknown VersionEdit tag {tag} (varint framing makes "
+                f"unknown tags unskippable; is this DB from a forked or "
+                f"pre-1.0 leveldb?)")
     return out
 
 
@@ -600,8 +609,11 @@ class LevelDBWriter:
         self.block_size = block_size
         self.compress = compress
         self._entries = []
+        self._closed = False
 
     def put(self, key, value):
+        if self._closed:
+            raise ValueError("put() on a closed LevelDBWriter")
         if isinstance(key, str):
             key = key.encode()
         if isinstance(value, str):
@@ -609,6 +621,12 @@ class LevelDBWriter:
         self._entries.append((bytes(key), bytes(value)))
 
     def close(self):
+        # idempotent: an explicit close() followed by the context
+        # manager's __exit__ (or any double close) must not rewrite the
+        # DB from the now-empty entry list
+        if self._closed:
+            return
+        self._closed = True
         seq = {}
         for i, (k, _) in enumerate(self._entries):
             seq[k] = i + 1               # later puts shadow earlier ones

@@ -8,10 +8,18 @@ Reference lrn_layer.cpp:
     Caffe AVE pooling's pad-inclusive divisor, which this reuses from ops.pooling.
 """
 
+import os
+
 from jax import lax
 import jax.numpy as jnp
 
 from ..graph.registry import Layer, register
+
+
+def _lrn_mode():
+    # read the env var here (NOT via pallas_lrn.lrn_mode) so the default
+    # xla path never imports pallas/mosaic at all
+    return os.environ.get("SPARKNET_LRN", "xla").lower()
 from .pooling import ave_pool, caffe_pool_geometry
 from ..proto.message import Message
 
@@ -44,6 +52,9 @@ class LRN(Layer):
             kernel, stride, pad, out = self.pool_geom
             s = ave_pool(x * x, kernel, stride, pad, out)
             scale = 1.0 + self.alpha * s
+        elif x.ndim == 4 and _lrn_mode() == "pallas":
+            from .pallas_lrn import lrn_across
+            return [lrn_across(x, self.size, self.alpha, self.beta, self.k)]
         else:
             half = (self.size - 1) // 2
             sq = x * x

@@ -23,15 +23,23 @@ scatter/gather (O(n log n + n*C), not an O(n^2) one-hot mask) and run
 identically on 1 device and on an N-way expert mesh, so the two paths
 agree exactly (tested).
 
-Scaling caveat: the current EP path assumes tokens are REPLICATED across
-the "expert" axis — every device builds the full (X, C, E) dispatch
-buffer, so after the all_to_all each device runs its X/ep experts on ep
-copies of the capacity slots. That shards expert *weight memory* (the
-usual MoE limiter) but not per-device expert FLOPs. Shrinking compute
-too requires sharding tokens along the expert axis (route only the local
-batch slice, capacity C/ep per peer) — compose the "expert" axis with the
-"data"/"seq" axes for that; under dpxep the batch sharding already
-divides the token count per device.
+EP shards compute, not just weights, when tokens arrive SHARDED along
+the expert axis (batch or sequence dim split over the same mesh axis,
+the usual dp-x-ep composition): routing/capacity math runs on the LOCAL
+token count, so each device builds an (X, C/ep, E) dispatch buffer and
+after the all_to_all runs its X/ep experts over ep*(C/ep) = C capacity
+slots — per-device expert FLOPs drop ep-fold with the axis
+(tested: test_moe.py asserts the traced buffer shape shrinks ep-fold on
+an 8-way mesh, and that the token-sharded forward equals the
+single-device forward). Capacity is enforced per SOURCE device (each
+peer may send at most C_local = ceil(n_local/X * capacity_factor)
+tokens to any one expert), which equals the global rule whenever
+routing doesn't overflow; under overflow the drop priority is
+per-device arrival order rather than global order. Tokens may also be
+passed REPLICATED across the axis — then the layer still shards expert
+weight memory (each device runs X/ep experts over every peer's
+identical slots) but per-device FLOPs don't shrink; that mode is only
+for weight-memory relief.
 
 Weight blobs (expert-major so a GSPMD param_rule or shard_map in_spec can
 shard dim 0 across the expert axis):
@@ -128,10 +136,16 @@ class MoE(Layer):
 
         ep_axis = context.axis("expert") if self.expert_parallel else None
         if ep_axis is not None:
-            # (X, C, e): split expert-major across the mesh, gather every
-            # peer's tokens for OUR experts along the capacity axis
+            # (X, C_local, e): split expert-major across the mesh, gather
+            # every peer's tokens for OUR experts along the capacity axis.
+            # With tokens sharded along the axis C_local = C/ep and this
+            # is the compute-sharded buffer; with tokens replicated it is
+            # (X/ep, ep*C, e) and only weight memory shrinks.
             xe = lax.all_to_all(xe, ep_axis, split_axis=0, concat_axis=1,
-                                tiled=True)                # (X/ep, ep*C, e)
+                                tiled=True)
+        # trace-time introspection for tests/tools: the per-device expert
+        # workload is exactly this shape's product
+        self._last_dispatch_shape = tuple(xe.shape)
 
         w1l, b1l, w2l, b2l = (w.astype(jnp.float32)
                               for w in (w1, b1, w2, b2))

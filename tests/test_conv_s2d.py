@@ -1,0 +1,97 @@
+"""Space-to-depth stem-conv rewrite == the plain strided conv, exactly.
+
+The rewrite (ops/convolution.py Convolution._s2d_conv) must be a pure
+trace-time transformation: same weight blob, same outputs, same gradients
+as the stock strided conv (reference conv1 geometries:
+bvlc_reference_caffenet/train_val.prototxt 11x11/4 pad 0,
+bvlc_googlenet/train_val.prototxt 7x7/2 pad 3).
+"""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from tests.test_layers import make_layer, init_params
+
+RNG = np.random.RandomState(3)
+
+GEOMETRIES = [
+    # (in_shape, num_output, kernel, stride, pad)  — name for ids
+    pytest.param((2, 3, 227, 227), 8, 11, 4, 0, id="caffenet-conv1"),
+    pytest.param((2, 3, 224, 224), 8, 7, 2, 3, id="googlenet-conv1"),
+    pytest.param((1, 3, 33, 33), 4, 5, 3, 2, id="odd-k5s3p2"),
+    pytest.param((1, 4, 16, 16), 4, 4, 4, 0, id="k-divisible-by-s"),
+    pytest.param((1, 2, 15, 17), 3, 3, 2, 1, id="rect-input"),
+]
+
+
+def _pair(monkeypatch, in_shape, num_output, k, s, p):
+    layer, _ = make_layer(
+        "Convolution", [in_shape],
+        convolution_param=dict(num_output=num_output, kernel_size=[k],
+                               stride=[s], pad=[p]))
+    params = init_params(layer)
+    x = jnp.asarray(RNG.randn(*in_shape), jnp.float32)
+    monkeypatch.setenv("SPARKNET_CONV_S2D", "off")
+    (ref,) = layer.apply(params, [x], False, None)
+    monkeypatch.setenv("SPARKNET_CONV_S2D", "on")
+    assert layer._s2d_eligible()
+    (got,) = layer.apply(params, [x], False, None)
+    return layer, params, x, ref, got
+
+
+@pytest.mark.parametrize("in_shape,num_output,k,s,p", GEOMETRIES)
+def test_forward_exact(monkeypatch, in_shape, num_output, k, s, p):
+    layer, params, x, ref, got = _pair(monkeypatch, in_shape, num_output,
+                                       k, s, p)
+    assert got.shape == tuple(layer.out_shapes()[0])
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("in_shape,num_output,k,s,p", GEOMETRIES[:3])
+def test_gradients_match(monkeypatch, in_shape, num_output, k, s, p):
+    layer, _ = make_layer(
+        "Convolution", [in_shape],
+        convolution_param=dict(num_output=num_output, kernel_size=[k],
+                               stride=[s], pad=[p]))
+    params = init_params(layer)
+    x = jnp.asarray(RNG.randn(*in_shape), jnp.float32)
+
+    def loss(w, xv):
+        (y,) = layer.apply([w, params[1]], [xv], False, None)
+        return (y * jnp.cos(jnp.arange(y.size, dtype=jnp.float32)
+                            .reshape(y.shape))).sum()
+
+    monkeypatch.setenv("SPARKNET_CONV_S2D", "off")
+    gw_ref, gx_ref = jax.grad(loss, argnums=(0, 1))(params[0], x)
+    monkeypatch.setenv("SPARKNET_CONV_S2D", "on")
+    gw, gx = jax.grad(loss, argnums=(0, 1))(params[0], x)
+    # weight grads must land on the stock (O, C, kh, kw) blob unchanged
+    assert gw.shape == params[0].shape
+    np.testing.assert_allclose(np.asarray(gw), np.asarray(gw_ref),
+                               rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(np.asarray(gx), np.asarray(gx_ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_auto_policy_targets_stem_convs(monkeypatch):
+    monkeypatch.setenv("SPARKNET_CONV_S2D", "auto")
+    stem, _ = make_layer(
+        "Convolution", [(1, 3, 32, 32)],
+        convolution_param=dict(num_output=8, kernel_size=[7], stride=[2]))
+    assert stem._s2d_eligible()
+    deep, _ = make_layer(    # 64 channels: lanes already well fed
+        "Convolution", [(1, 64, 16, 16)],
+        convolution_param=dict(num_output=8, kernel_size=[3], stride=[2]))
+    assert not deep._s2d_eligible()
+    grouped, _ = make_layer(
+        "Convolution", [(1, 4, 16, 16)],
+        convolution_param=dict(num_output=8, kernel_size=[3], stride=[2],
+                               group=2))
+    assert not grouped._s2d_eligible()
+    unstrided, _ = make_layer(
+        "Convolution", [(1, 3, 16, 16)],
+        convolution_param=dict(num_output=8, kernel_size=[3]))
+    assert not unstrided._s2d_eligible()

@@ -110,6 +110,17 @@ def test_writer_reader_roundtrip(tmp_path):
     assert got == sorted(items)
 
 
+def test_writer_double_close_keeps_data(tmp_path):
+    # explicit close() + the context manager's __exit__ close: the second
+    # close must be a no-op, not a rewrite of the DB from an empty list
+    path = str(tmp_path / "db")
+    with LevelDBWriter(path) as w:
+        w.put(b"k", b"v")
+        w.close()
+    with LevelDBReader(path) as r:
+        assert list(r.items()) == [(b"k", b"v")]
+
+
 def test_reader_unsorted_puts_and_shadowing(tmp_path):
     path = str(tmp_path / "db")
     with LevelDBWriter(path) as w:

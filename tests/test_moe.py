@@ -116,6 +116,45 @@ def test_moe_expert_parallel_matches_single_device():
                                atol=2e-5)
 
 
+def test_moe_expert_parallel_shards_compute():
+    """Tokens sharded along the expert axis (the dp-x-ep composition):
+    the per-device dispatch buffer must shrink ep-fold vs replicated
+    tokens, and the forward must equal the single-device forward."""
+    X, EP, E = 8, 8, 16
+    # capacity_factor = X so no token can ever overflow, locally or
+    # globally -> sharded and unsharded routing are identical
+    layer, _ = make_layer("MoE", [(2, 16, E)],
+                          moe_param=dict(num_experts=X,
+                                         capacity_factor=float(X),
+                                         expert_parallel=True))
+    params = _params(layer, seed=4)
+    x = jnp.asarray(np.random.RandomState(5).randn(2, 16, E), jnp.float32)
+    n = 2 * 16
+
+    with context.axis_context():            # single device reference
+        (want,) = layer.apply(params, [x], True, None)
+    assert layer._last_dispatch_shape == (X, n, E)   # C = n at cf = X
+
+    mesh = make_mesh({"expert": EP})
+
+    def fwd(router, w1, b1, w2, b2, xs):
+        (y,) = layer.apply([router, w1, b1, w2, b2], [xs], True, None)
+        return y
+
+    with context.axis_context(expert="expert"):
+        sharded = jax.jit(jax.shard_map(
+            fwd, mesh=mesh,
+            in_specs=(P(), P("expert"), P("expert"), P("expert"),
+                      P("expert"), P(None, "expert")),   # tokens SHARDED
+            out_specs=P(None, "expert"), check_vma=False))
+        out = sharded(*params, x)
+    # per-device workload: X/EP experts over ep*C_local = n slots = an
+    # EP-fold shrink from the replicated-token EP shape (X/EP, EP*n, E)
+    assert layer._last_dispatch_shape == (X // EP, n, E)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                               atol=2e-5)
+
+
 def test_moe_in_transformer_net_trains():
     """MoE as the FFN of a one-block net: loss_fn runs and decreases."""
     from sparknet_tpu.solver.solver import Solver

@@ -137,6 +137,104 @@ except ValueError as e:
 """
 
 
+_WORKER4 = r"""
+import os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+import jax
+jax.config.update("jax_platforms", "cpu")
+pid = int(sys.argv[1]); port = sys.argv[2]
+jax.distributed.initialize(f"localhost:{port}", num_processes=4,
+                           process_id=pid)
+import numpy as np
+sys.path.insert(0, %(repo)r)
+from sparknet_tpu.proto import Message
+from sparknet_tpu.models import zoo
+from sparknet_tpu.parallel import (make_mesh, DataParallelSolver,
+                                   LocalSGDSolver, GSPMDSolver,
+                                   local_batch_slice)
+
+GLOBAL_BATCH, TAU = 16, 2
+q = GLOBAL_BATCH // 4            # this host's slice (4 of 16)
+
+# --- 1. per-step DP: 4 hosts x 2 devices, one gradient pmean a step ---
+sp = Message("SolverParameter", base_lr=0.05, lr_policy="fixed",
+             momentum=0.9, display=0, random_seed=0)
+dp = DataParallelSolver(sp, mesh=make_mesh({"data": 8}),
+                        net_param=zoo.lenet(batch_size=GLOBAL_BATCH))
+rs = np.random.RandomState(0)
+losses = []
+for step in range(3):
+    data = rs.randn(GLOBAL_BATCH, 1, 28, 28).astype(np.float32)
+    label = rs.randint(0, 10, GLOBAL_BATCH)
+    start, size = local_batch_slice(GLOBAL_BATCH)
+    assert (start, size) == (pid * q, q), (start, size)
+    losses.append(float(dp.train_step(
+        {"data": data[start:start + size],
+         "label": label[start:start + size]})))
+print("DP_LOSSES", pid, " ".join(f"{v:.6f}" for v in losses), flush=True)
+
+# --- 2. the SparkNet round: tau local steps then one weight average ---
+sp2 = Message("SolverParameter", base_lr=0.005, lr_policy="fixed",
+              momentum=0.9, display=0, random_seed=0)
+ls = LocalSGDSolver(sp2, mesh=make_mesh({"data": 8}), tau=TAU,
+                    net_param=zoo.lenet(batch_size=GLOBAL_BATCH // 8))
+rs = np.random.RandomState(0)
+slosses = []
+for rnd in range(2):
+    data = rs.randn(TAU, GLOBAL_BATCH, 1, 28, 28).astype(np.float32)
+    label = rs.randint(0, 10, (TAU, GLOBAL_BATCH))
+    slosses.append(float(ls.train_round(
+        {"data": data[:, pid * q:(pid + 1) * q],
+         "label": label[:, pid * q:(pid + 1) * q]})))
+print("SGD_LOSSES", pid, " ".join(f"{v:.6f}" for v in slosses), flush=True)
+tot = sum(float(np.abs(np.asarray(b)).sum())
+          for bs in ls.params.values() for b in bs)
+print("SGD_PARAM_SUM", pid, f"{tot:.6f}", flush=True)
+
+# --- 3. GSPMD dp x tp spanning hosts (tp pairs cross process pairs) ---
+sp3 = Message("SolverParameter", base_lr=0.05, lr_policy="fixed",
+              momentum=0.9, display=0, random_seed=0)
+gs = GSPMDSolver(sp3, mesh=make_mesh({"data": 4, "model": 2}),
+                 net_param=zoo.lenet(batch_size=GLOBAL_BATCH))
+rs = np.random.RandomState(1)
+glosses = []
+for step in range(3):
+    data = rs.randn(GLOBAL_BATCH, 1, 28, 28).astype(np.float32)
+    label = rs.randint(0, 10, GLOBAL_BATCH)
+    glosses.append(float(gs.train_step(
+        {"data": data[pid * q:(pid + 1) * q],
+         "label": label[pid * q:(pid + 1) * q]})))
+print("GSPMD_LOSSES", pid, " ".join(f"{v:.6f}" for v in glosses),
+      flush=True)
+
+# --- 4. global batch not divisible by the 8-slot mesh: clean error ---
+try:
+    DataParallelSolver(sp3, mesh=make_mesh({"data": 8}),
+                       net_param=zoo.lenet(batch_size=18))
+    print("NONDIV", pid, "NO_ERROR", flush=True)
+except ValueError as e:
+    msg = str(e)
+    ok = "18" in msg and "8" in msg
+    print("NONDIV", pid, "OK" if ok else "BAD_MSG:" + repr(msg), flush=True)
+"""
+
+
+# a worker that joins the coordinator with a short timeout; used with one
+# process deliberately missing to exercise the dead-peer failure path
+_WORKER_DEADPEER = r"""
+import os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+import jax
+jax.config.update("jax_platforms", "cpu")
+pid = int(sys.argv[1]); port = sys.argv[2]
+jax.distributed.initialize(f"localhost:{port}", num_processes=4,
+                           process_id=pid, initialization_timeout=15)
+print("JOINED", pid, flush=True)
+"""
+
+
 def _run_workers(script_text, tmp_path, n=2, timeout=900):
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     script = tmp_path / "worker.py"
@@ -157,14 +255,14 @@ def _run_workers(script_text, tmp_path, n=2, timeout=900):
     return outs
 
 
-def _collect(outs, tag):
+def _collect(outs, tag, n=2):
     per = {}
     for out in outs:
         for line in out.splitlines():
             if line.startswith(tag + " "):
                 parts = line.split()
                 per[int(parts[1])] = parts[2:]
-    assert set(per) == {0, 1}, f"{tag}: missing a process: {per}"
+    assert set(per) == set(range(n)), f"{tag}: missing a process: {per}"
     return per
 
 
@@ -221,6 +319,90 @@ def test_two_process_check_batch_error(strategy_outs):
     per = _collect(strategy_outs, "CHECKBATCH")
     assert per[0][0] == "OK", per[0]
     assert per[1][0] == "OK", per[1]
+
+
+@pytest.fixture(scope="module")
+def four_proc_outs(tmp_path_factory):
+    """One 4-process x 2-device run: DP, LocalSGD, GSPMD, non-divisible
+    batch — the assembly/slicing logic that broke in round 2 exercised
+    past the 2-process case."""
+    return _run_workers(_WORKER4, tmp_path_factory.mktemp("mh4"), n=4,
+                        timeout=1500)
+
+
+def test_four_process_dp_and_single_process_parity(four_proc_outs):
+    per = _collect(four_proc_outs, "DP_LOSSES", n=4)
+    for pid in (1, 2, 3):
+        np.testing.assert_allclose([float(v) for v in per[0]],
+                                   [float(v) for v in per[pid]], rtol=1e-5)
+    # matches the identical run done in ONE process on the 8-slot mesh
+    from sparknet_tpu.proto import Message
+    from sparknet_tpu.models import zoo
+    from sparknet_tpu.parallel import make_mesh, DataParallelSolver
+    sp = Message("SolverParameter", base_lr=0.05, lr_policy="fixed",
+                 momentum=0.9, display=0, random_seed=0)
+    solver = DataParallelSolver(sp, mesh=make_mesh({"data": 8}),
+                                net_param=zoo.lenet(batch_size=16))
+    rs = np.random.RandomState(0)
+    ref = []
+    for step in range(3):
+        data = rs.randn(16, 1, 28, 28).astype(np.float32)
+        label = rs.randint(0, 10, 16)
+        ref.append(float(solver.train_step({"data": data, "label": label})))
+    np.testing.assert_allclose([float(v) for v in per[0]], ref,
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_four_process_local_sgd_round(four_proc_outs):
+    per = _collect(four_proc_outs, "SGD_LOSSES", n=4)
+    for pid in (1, 2, 3):
+        np.testing.assert_allclose([float(v) for v in per[0]],
+                                   [float(v) for v in per[pid]], rtol=1e-5)
+    sums = _collect(four_proc_outs, "SGD_PARAM_SUM", n=4)
+    vals = [float(sums[pid][0]) for pid in range(4)]
+    assert max(vals) - min(vals) < 1e-3, vals
+
+
+def test_four_process_gspmd_step(four_proc_outs):
+    per = _collect(four_proc_outs, "GSPMD_LOSSES", n=4)
+    for pid in (1, 2, 3):
+        np.testing.assert_allclose([float(v) for v in per[0]],
+                                   [float(v) for v in per[pid]], rtol=1e-5)
+
+
+def test_four_process_nondivisible_batch_error(four_proc_outs):
+    per = _collect(four_proc_outs, "NONDIV", n=4)
+    for pid in range(4):
+        assert per[pid][0] == "OK", (pid, per[pid])
+
+
+def test_dead_peer_times_out_cleanly(tmp_path):
+    """3 of 4 workers show up; the missing peer must surface as a bounded
+    initialization timeout, not a hang (the reference leaned on Spark's
+    maxFailures=1 fail-fast — this is our equivalent property)."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = tmp_path / "worker.py"
+    script.write_text(_WORKER_DEADPEER % {"repo": repo})
+    port = _free_port()
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
+    procs = [subprocess.Popen([sys.executable, str(script), str(i),
+                               str(port)],
+                              stdout=subprocess.PIPE,
+                              stderr=subprocess.PIPE, text=True, env=env)
+             for i in range(3)]           # process 3 never starts
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=300)
+            assert p.returncode != 0, f"worker should have failed:\n{out}"
+            assert "JOINED" not in out
+            assert "timed out" in err.lower() or "timeout" in err.lower() \
+                or "deadline" in err.lower(), err[-2000:]
+    finally:
+        for p in procs:                   # never leak workers on a hang
+            if p.poll() is None:
+                p.kill()
+                p.wait()
 
 
 def test_two_process_dp_matches_single_process(tmp_path):

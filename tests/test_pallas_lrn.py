@@ -1,0 +1,72 @@
+"""Pallas fused LRN == the XLA reduce_window LRN, forward and gradient.
+
+Runs the pallas kernels in interpreter mode on CPU (the same kernels the
+TPU compiles natively), against the stock ops/lrn.py XLA path as the
+reference — which is itself forward-checked against the Caffe formula in
+test_layers.py.
+"""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from tests.test_layers import make_layer
+
+RNG = np.random.RandomState(5)
+
+CASES = [
+    # (shape, local_size, alpha, beta, k)
+    pytest.param((2, 96, 9, 11), 5, 1e-4, 0.75, 1.0, id="caffenet-norm1ish"),
+    pytest.param((1, 64, 8, 8), 5, 5e-5, 0.75, 2.0, id="k-not-1"),
+    pytest.param((2, 32, 6, 130), 3, 1e-3, 0.5, 1.0, id="size3-wide-spatial"),
+]
+
+
+def _lrn_pair(monkeypatch, shape, size, alpha, beta, k):
+    layer, _ = make_layer(
+        "LRN", [shape],
+        lrn_param=dict(local_size=size, alpha=alpha, beta=beta, k=k))
+    x = jnp.asarray(RNG.randn(*shape), jnp.float32)
+
+    def apply(mode, v):
+        monkeypatch.setenv("SPARKNET_LRN", mode)
+        return layer.apply([], [v], False, None)[0]
+
+    return apply, x
+
+
+@pytest.mark.parametrize("shape,size,alpha,beta,k", CASES)
+def test_forward_matches_xla(monkeypatch, shape, size, alpha, beta, k):
+    apply, x = _lrn_pair(monkeypatch, shape, size, alpha, beta, k)
+    ref = apply("xla", x)
+    got = apply("pallas", x)
+    assert got.shape == ref.shape and got.dtype == ref.dtype
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("shape,size,alpha,beta,k", CASES)
+def test_gradient_matches_xla(monkeypatch, shape, size, alpha, beta, k):
+    apply, x = _lrn_pair(monkeypatch, shape, size, alpha, beta, k)
+
+    def loss(mode, v):
+        y = apply(mode, v)
+        return (y * jnp.sin(jnp.arange(y.size, dtype=jnp.float32)
+                            .reshape(y.shape))).sum()
+
+    g_ref = jax.grad(lambda v: loss("xla", v))(x)
+    g = jax.grad(lambda v: loss("pallas", v))(x)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(g_ref),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_bf16_activation_dtype_roundtrip(monkeypatch):
+    apply, x = _lrn_pair(monkeypatch, (1, 32, 4, 36), 5, 1e-4, 0.75, 1.0)
+    xb = x.astype(jnp.bfloat16)
+    got = apply("pallas", xb)
+    ref = apply("xla", xb)
+    assert got.dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(got, np.float32),
+                               np.asarray(ref, np.float32),
+                               rtol=2e-2, atol=2e-2)
