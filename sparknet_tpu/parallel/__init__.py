@@ -26,7 +26,7 @@ __all__ = [
     "make_mesh", "mesh_axis_size", "distributed_init", "local_batch_slice",
     "axis_context", "current_axes", "context",
     "DataParallelSolver", "LocalSGDSolver", "shard_batch",
-    "GSPMDSolver", "default_param_rule",
+    "GSPMDSolver", "default_param_rule", "SeqParallelSolver",
     "ring_attention", "ulysses_attention", "sequence_sharded_apply",
     "gpipe", "pipeline_apply", "stack_params", "PipelineLMSolver",
 ]
@@ -41,6 +41,7 @@ _EXPORTS = {
     "DataParallelSolver": "data_parallel", "LocalSGDSolver": "data_parallel",
     "shard_batch": "data_parallel",
     "GSPMDSolver": "gspmd", "default_param_rule": "gspmd",
+    "SeqParallelSolver": "seq_parallel",
     "ring_attention": "ring", "ulysses_attention": "ring",
     "sequence_sharded_apply": "ring",
     "gpipe": "pipeline", "pipeline_apply": "pipeline",

@@ -27,51 +27,75 @@ from .mesh import DATA_AXIS
 from . import context
 
 
-def shard_batch(batch, mesh, axis=DATA_AXIS, batch_dim=0):
+def shard_batch(batch, mesh, axis=DATA_AXIS, batch_dim=0, seq_axis=None,
+                seq_dim=1):
     """Place a batch dict onto the mesh, sharded along the batch dimension —
-    the analog of an RDD partition landing on its executor.
+    the analog of an RDD partition landing on its executor. With
+    ``seq_axis``, rank>=2 blobs are additionally sharded along ``seq_dim``
+    (the dp x sp placement of SeqParallelSolver).
 
     Single-process: ``batch`` is the global batch; device_put scatters it.
     Multi-process (jax.process_count() > 1): each host passes only ITS slice
     of the global batch (see mesh.local_batch_slice — the per-worker RDD
     partition of CifarApp.scala:56-64) and the global array is assembled
     from the per-host shards without any host ever holding the full batch.
+    Already-on-device jax arrays are resharded without a host round trip.
     """
-    spec = [None] * (batch_dim + 1)
-    spec[batch_dim] = axis
     multihost = jax.process_count() > 1
     out = {}
     for k, v in batch.items():
-        v = np.asarray(v)
-        s = P(*spec[:v.ndim]) if v.ndim else P()
+        if not isinstance(v, jax.Array):
+            v = np.asarray(v)
+        s = _one_spec(np.ndim(v), axis, batch_dim, seq_axis, seq_dim)
         sharding = NamedSharding(mesh, s)
-        if multihost and v.ndim:
-            out[k] = jax.make_array_from_process_local_data(sharding, v)
+        if multihost and np.ndim(v):
+            out[k] = jax.make_array_from_process_local_data(
+                sharding, np.asarray(v))
         else:
             out[k] = jax.device_put(v, sharding)
     return out
 
 
-def _rebatch(net, n):
-    """Compile a per-shard twin of ``net``: identical params/layers, feed
-    blobs with leading (batch) dim divided by ``n``."""
+def _rebatch(net, n, seq=1):
+    """Compile a per-shard twin of ``net``: identical params/layers and
+    precision, feed blobs with leading (batch) dim divided by ``n`` (and,
+    for ``seq > 1``, dim 1 divided by ``seq``)."""
     from ..graph.compiler import CompiledNet
     local = {}
     for name, s in net.feed_shapes().items():
-        if s and s[0] % n == 0:
-            local[name] = (s[0] // n,) + tuple(s[1:])
-        elif s:
+        if not s:
+            local[name] = s
+            continue
+        if s[0] % n:
             raise ValueError(
                 f"feed blob {name!r} batch {s[0]} not divisible by mesh "
                 f"axis size {n}")
+        out = [s[0] // n] + list(s[1:])
+        if seq > 1:
+            if len(s) < 2 or s[1] % seq:
+                raise ValueError(
+                    f"feed blob {name!r} seq dim "
+                    f"{s[1] if len(s) > 1 else '<missing>'} not divisible "
+                    f"by seq axis size {seq}")
+            out[1] = s[1] // seq
+        local[name] = tuple(out)
     return CompiledNet(net.net_param, net.phase, feed_shapes=local,
-                       dtype=net.dtype)
+                       dtype=net.dtype, compute_dtype=net.compute_dtype)
 
 
-def _batch_specs(batch, axis, batch_dim=0):
-    spec = [None] * (batch_dim + 1)
-    spec[batch_dim] = axis
-    return {k: (P(*spec[:np.ndim(v)]) if np.ndim(v) else P())
+def _one_spec(ndim, axis, batch_dim=0, seq_axis=None, seq_dim=1):
+    if not ndim:
+        return P()
+    spec = [None] * ndim
+    if batch_dim < ndim:
+        spec[batch_dim] = axis
+    if seq_axis is not None and seq_dim < ndim:
+        spec[seq_dim] = seq_axis
+    return P(*spec)
+
+
+def _batch_specs(batch, axis, batch_dim=0, seq_axis=None, seq_dim=1):
+    return {k: _one_spec(np.ndim(v), axis, batch_dim, seq_axis, seq_dim)
             for k, v in batch.items()}
 
 

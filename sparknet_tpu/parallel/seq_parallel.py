@@ -1,0 +1,152 @@
+"""dp x sp solver: data parallelism composed with sequence parallelism.
+
+The long-context training runner: batch dim sharded over a "data" mesh
+axis, sequence dim sharded over a "seq" axis. Inside the shard_map the
+net's sequence-aware layers pick the "seq" axis up from parallel.context
+— Attention(ring=True) runs ring attention (parallel/ring.py: K/V blocks
+rotate via ppermute, O(S/sp) memory per chip), PositionalEmbed offsets
+its table lookup by the shard's global position, and SoftmaxWithLoss's
+per-token mean distributes exactly over equal shards, so
+
+    pmean_{data,seq}(local loss) == the single-device loss
+
+and one grads-pmean over both axes makes the update identical to
+single-device training on the global batch (test_seq_parallel.py asserts
+the whole loss CURVE matches to tolerance).
+
+The reference has no sequence dimension at all (CNN-era; SURVEY.md
+section 5 lists long-context as a framework extension); the analog of
+this file's job there is P2PSync's single data axis (parallel.cpp), which
+here is just the "data" half of the mesh.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..solver.solver import Solver
+from .data_parallel import _rebatch, _batch_specs, shard_batch
+from . import context
+
+
+class SeqParallelSolver(Solver):
+    """Solver whose step runs under shard_map over ("data", "seq"):
+    batch dim 0 sharded over data, dim 1 (sequence) sharded over seq;
+    params/state/history replicated; grads pmean'd over both axes.
+
+    Single-process (one host driving the whole mesh) for now: the base
+    check_batch's per-host slicing rule divides the BATCH dim by process
+    count, which contradicts the seq-dim placement a multi-host seq mesh
+    would need — guarded at construction rather than failing obscurely
+    at the first step."""
+
+    def __init__(self, solver_param, mesh=None, data_axis="data",
+                 seq_axis="seq", **kw):
+        from .mesh import make_mesh
+        if jax.process_count() > 1:
+            raise NotImplementedError(
+                "SeqParallelSolver is single-process: multi-host feeding "
+                "would need per-host SEQUENCE slices, not the batch "
+                "slices check_batch/local_batch_slice implement")
+        self.mesh = mesh if mesh is not None else \
+            make_mesh({data_axis: 1, seq_axis: -1})
+        self.data_axis, self.seq_axis = data_axis, seq_axis
+        if int(solver_param.iter_size) > 1:
+            raise ValueError("SeqParallelSolver does not support "
+                             "iter_size > 1")
+        super().__init__(solver_param, **kw)
+        dp = self.mesh.shape[data_axis]
+        sp = self.mesh.shape[seq_axis]
+        self.local_net = _rebatch(self.net, dp, seq=sp)
+        self.local_test_net = _rebatch(self.test_net, dp, seq=sp) \
+            if self.test_net is not None else None
+
+    def _axes_context(self):
+        return context.axis_context(data=self.data_axis, seq=self.seq_axis)
+
+    def _batch_spec(self, batch):
+        return _batch_specs(batch, self.data_axis,
+                            seq_axis=self.seq_axis)
+
+    def _sharded_step(self, batch_example):
+        net, updater, lr_fn = self.local_net, self.updater, self.lr_fn
+        da, sa = self.data_axis, self.seq_axis
+        loss_fn = self._wrapped_loss(net)
+
+        def step(params, state, history, batch, it, rng):
+            # distinct rng stream per shard (dropout etc.)
+            flat_idx = jax.lax.axis_index(da) * jax.lax.axis_size(sa) \
+                + jax.lax.axis_index(sa)
+            rng = jax.random.fold_in(rng, flat_idx)
+
+            def lf(p):
+                loss, (blobs, new_state) = loss_fn(p, state, batch, rng)
+                return loss, new_state
+            (loss, state), grads = jax.value_and_grad(
+                lf, has_aux=True)(params)
+            grads = jax.lax.pmean(jax.lax.pmean(grads, sa), da)
+            loss = jax.lax.pmean(jax.lax.pmean(loss, sa), da)
+            state = jax.lax.pmean(jax.lax.pmean(state, sa), da)
+            params, history = updater(params, grads, history, lr_fn(it), it)
+            return params, state, history, loss, it + 1
+
+        bspec = self._batch_spec(batch_example)
+        sharded = jax.shard_map(
+            step, mesh=self.mesh,
+            in_specs=(P(), P(), P(), bspec, P(), P()),
+            out_specs=(P(), P(), P(), P(), P()),
+            check_vma=False)
+        return jax.jit(sharded, donate_argnums=(0, 1, 2))
+
+    def _build_train_step(self):
+        return None              # built lazily on the first batch
+
+    def _shard(self, batch):
+        return shard_batch(batch, self.mesh, self.data_axis,
+                           seq_axis=self.seq_axis)
+
+    def train_step(self, batch):
+        self.check_batch(batch)
+        self.rng, key = jax.random.split(self.rng)
+        with self._axes_context():
+            if self._jit_train is None:
+                self._jit_train = self._sharded_step(batch)
+            dev = self._shard(batch)
+            if self._it_dev is None:     # device-resident counter, like
+                self._it_dev = jnp.asarray(self.iter, jnp.int32)  # Solver
+            (self.params, self.state, self.history, loss,
+             self._it_dev) = self._jit_train(
+                self.params, self.state, self.history, dev,
+                self._it_dev, key)
+        self.iter += 1
+        return loss
+
+    def _build_eval_step(self):
+        net = self.local_test_net
+        da, sa = self.data_axis, self.seq_axis
+        tf = self.test_input_transform
+        compiled = {}
+
+        def ev(params, state, batch):
+            if tf is not None:
+                batch = tf(batch)
+            blobs, _ = net.apply(params, state, batch, train=False)
+            return {b: jax.lax.pmean(jax.lax.pmean(
+                jnp.asarray(blobs[b], jnp.float32), sa), da)
+                    for b in net.output_blobs}
+
+        def stepper(params, state, batch):
+            # no np.asarray: test() feeds device arrays and a forced
+            # fetch would serialize its pipelined eval loop
+            key = tuple(sorted((k, tuple(np.shape(v)))
+                               for k, v in batch.items()))
+            with self._axes_context():
+                if key not in compiled:
+                    bspec = self._batch_spec(batch)
+                    compiled[key] = jax.jit(jax.shard_map(
+                        ev, mesh=self.mesh, in_specs=(P(), P(), bspec),
+                        out_specs=P(), check_vma=False))
+                return compiled[key](params, state, self._shard(batch))
+
+        return stepper

@@ -1,0 +1,63 @@
+"""SeqParallelSolver (dp x sp): the sequence-sharded LM training
+trajectory == single-device training on the global batch.
+
+This is the trained-curve evidence for ring attention that the per-op
+exactness tests (test_flash.py ring-vs-dense) don't give: position
+embeddings offset per shard, causal ring attention across the seq axis,
+per-token loss pmean'd over both axes, momentum updates from pmean'd
+grads — all of it, stepped repeatedly, must reproduce the single-device
+loss curve."""
+
+import numpy as np
+import pytest
+import jax
+
+from sparknet_tpu.proto import Message
+from sparknet_tpu.models import zoo
+from sparknet_tpu.solver.solver import Solver
+from sparknet_tpu.parallel import make_mesh, SeqParallelSolver
+
+B, S, V, D = 4, 32, 64, 32
+STEPS = 12
+
+
+def _sp():
+    return Message("SolverParameter", base_lr=0.1, lr_policy="fixed",
+                   momentum=0.9, display=0, random_seed=0)
+
+
+def _net():
+    return zoo.transformer_lm(vocab_size=V, seq_len=S, batch_size=B,
+                              d_model=D, num_layers=2, num_heads=2,
+                              flash=False, ring=True)
+
+
+def _batches():
+    rs = np.random.RandomState(0)
+    toks = rs.randint(0, V, (STEPS, B, S + 1))
+    return [{"data": t[:, :-1], "label": t[:, 1:]} for t in toks]
+
+
+def _curve(solver):
+    return [float(solver.train_step(b)) for b in _batches()]
+
+
+@pytest.mark.parametrize("axes", [{"data": 2, "seq": 4},
+                                  {"data": 1, "seq": 8}])
+def test_sp_curve_matches_single_device(axes):
+    ref = _curve(Solver(_sp(), net_param=zoo.transformer_lm(
+        vocab_size=V, seq_len=S, batch_size=B, d_model=D, num_layers=2,
+        num_heads=2, flash=False, ring=False)))
+    got = _curve(SeqParallelSolver(_sp(), mesh=make_mesh(axes),
+                                   net_param=_net()))
+    np.testing.assert_allclose(got, ref, rtol=2e-3, atol=2e-3)
+    assert got[-1] < got[0] - 0.1          # and it is actually learning
+
+
+def test_sp_rejects_indivisible_dims():
+    with pytest.raises(ValueError, match="seq dim"):
+        SeqParallelSolver(_sp(), mesh=make_mesh({"data": 1, "seq": 8}),
+                          net_param=zoo.transformer_lm(
+                              vocab_size=V, seq_len=S + 4, batch_size=B,
+                              d_model=D, num_layers=1, num_heads=2,
+                              flash=False, ring=True))
