@@ -28,18 +28,28 @@ from . import context
 
 
 def shard_batch(batch, mesh, axis=DATA_AXIS, batch_dim=0, seq_axis=None,
-                seq_dim=1):
+                seq_dim=1, global_feed=False):
     """Place a batch dict onto the mesh, sharded along the batch dimension —
     the analog of an RDD partition landing on its executor. With
     ``seq_axis``, rank>=2 blobs are additionally sharded along ``seq_dim``
     (the dp x sp placement of SeqParallelSolver).
 
     Single-process: ``batch`` is the global batch; device_put scatters it.
-    Multi-process (jax.process_count() > 1): each host passes only ITS slice
-    of the global batch (see mesh.local_batch_slice — the per-worker RDD
-    partition of CifarApp.scala:56-64) and the global array is assembled
-    from the per-host shards without any host ever holding the full batch.
-    Already-on-device jax arrays are resharded without a host round trip.
+    Multi-process (jax.process_count() > 1), two feeding disciplines:
+      * global_feed=False — each host passes only ITS slice of the batch
+        axis (see mesh.local_batch_slice — the per-worker RDD partition of
+        CifarApp.scala:56-64); the global array is assembled from per-host
+        shards without any host holding the full batch. Right for image
+        batches.
+      * global_feed=True — each host passes the FULL global batch and its
+        devices pull their blocks via make_array_from_callback. Right when
+        the batch is small but sharded along dims a per-host batch slice
+        can't express (the sequence axis: a seq mesh axis spanning hosts
+        needs per-host SEQUENCE blocks, which hosts can cheaply slice from
+        the whole token array).
+    Single-process, already-on-device jax arrays are resharded without a
+    host round trip; the multihost assembly paths need host-resident data
+    and will fetch a device-resident input first.
     """
     multihost = jax.process_count() > 1
     out = {}
@@ -49,8 +59,13 @@ def shard_batch(batch, mesh, axis=DATA_AXIS, batch_dim=0, seq_axis=None,
         s = _one_spec(np.ndim(v), axis, batch_dim, seq_axis, seq_dim)
         sharding = NamedSharding(mesh, s)
         if multihost and np.ndim(v):
-            out[k] = jax.make_array_from_process_local_data(
-                sharding, np.asarray(v))
+            if global_feed:
+                arr = np.asarray(v)
+                out[k] = jax.make_array_from_callback(
+                    arr.shape, sharding, lambda idx, a=arr: a[idx])
+            else:
+                out[k] = jax.make_array_from_process_local_data(
+                    sharding, np.asarray(v))
         else:
             out[k] = jax.device_put(v, sharding)
     return out

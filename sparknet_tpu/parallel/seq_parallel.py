@@ -35,20 +35,24 @@ class SeqParallelSolver(Solver):
     batch dim 0 sharded over data, dim 1 (sequence) sharded over seq;
     params/state/history replicated; grads pmean'd over both axes.
 
-    Single-process (one host driving the whole mesh) for now: the base
-    check_batch's per-host slicing rule divides the BATCH dim by process
-    count, which contradicts the seq-dim placement a multi-host seq mesh
-    would need — guarded at construction rather than failing obscurely
-    at the first step."""
+    Multi-process feeding discipline: EVERY host passes the full global
+    batch (token blobs are bytes-per-element small, unlike image
+    batches) and shard_batch's callback path hands each host's devices
+    their (data, seq) blocks — per-host batch slicing can't express a
+    sequence axis that spans hosts. check_batch therefore validates
+    against GLOBAL shapes on every host."""
 
     def __init__(self, solver_param, mesh=None, data_axis="data",
                  seq_axis="seq", **kw):
         from .mesh import make_mesh
-        if jax.process_count() > 1:
-            raise NotImplementedError(
-                "SeqParallelSolver is single-process: multi-host feeding "
-                "would need per-host SEQUENCE slices, not the batch "
-                "slices check_batch/local_batch_slice implement")
+        if jax.process_count() > 1 and int(solver_param.random_seed) < 0:
+            # every replicated input (params at init, the dropout key per
+            # step) must be IDENTICAL across hosts; an unset seed falls
+            # back to per-host clock entropy and training silently desyncs
+            raise ValueError(
+                "multi-process SeqParallelSolver requires an explicit "
+                "SolverParameter.random_seed: hosts must agree on param "
+                "init and rng streams")
         self.mesh = mesh if mesh is not None else \
             make_mesh({data_axis: 1, seq_axis: -1})
         self.data_axis, self.seq_axis = data_axis, seq_axis
@@ -104,10 +108,10 @@ class SeqParallelSolver(Solver):
 
     def _shard(self, batch):
         return shard_batch(batch, self.mesh, self.data_axis,
-                           seq_axis=self.seq_axis)
+                           seq_axis=self.seq_axis, global_feed=True)
 
     def train_step(self, batch):
-        self.check_batch(batch)
+        self.check_batch(batch, split_across_hosts=False)
         self.rng, key = jax.random.split(self.rng)
         with self._axes_context():
             if self._jit_train is None:

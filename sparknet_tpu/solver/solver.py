@@ -227,13 +227,15 @@ class Solver:
         return self.watchdog
 
     # -- public API --------------------------------------------------------
-    def check_batch(self, batch, leading=()):
+    def check_batch(self, batch, leading=(), split_across_hosts=True):
         """Fail fast with blob names when a feed array has the wrong shape
         (otherwise the error is a cryptic reshape deep inside some layer).
         Multi-process: each host feeds its 1/process_count slice of the
         batch axis (shard_batch assembles the global array), so the
-        expected leading batch dim shrinks accordingly."""
-        pcount = jax.process_count()
+        expected leading batch dim shrinks accordingly — unless the
+        caller feeds every host the full global batch
+        (split_across_hosts=False, the SeqParallelSolver discipline)."""
+        pcount = jax.process_count() if split_across_hosts else 1
         shapes = dict(self.net.feed_shapes())
         if self._raw_feed_shapes:
             # device-side transform: the host feeds the RAW source extent

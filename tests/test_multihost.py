@@ -220,6 +220,58 @@ except ValueError as e:
 """
 
 
+# one config shared VERBATIM by the 2-process workers and the in-process
+# single-process reference, so the two halves cannot drift apart
+_SP_CFG = dict(B=2, S=32, V=32, D=16, lr=0.1, steps=3)
+
+_WORKER_SP = r"""
+import os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+import jax
+jax.config.update("jax_platforms", "cpu")
+pid = int(sys.argv[1]); port = sys.argv[2]
+jax.distributed.initialize(f"localhost:{port}", num_processes=2,
+                           process_id=pid)
+import numpy as np
+sys.path.insert(0, %(repo)r)
+sys.path.insert(0, os.path.join(%(repo)r, "tests"))
+from test_multihost import _sp_solver_and_batches
+
+solver, batches = _sp_solver_and_batches()
+losses = []
+for b in batches:
+    # EVERY host feeds the full global batch (the seq-parallel feeding
+    # discipline); devices pull their own sequence blocks
+    losses.append(float(solver.train_step(b)))
+print("SP_LOSSES", pid, " ".join(f"{v:.6f}" for v in losses), flush=True)
+"""
+
+
+def _sp_solver_and_batches():
+    """The ONE seq-parallel config both the multihost workers and the
+    single-process reference train (imported by _WORKER_SP too)."""
+    import numpy as np
+    from sparknet_tpu.proto import Message
+    from sparknet_tpu.models import zoo
+    from sparknet_tpu.parallel import make_mesh, SeqParallelSolver
+    c = _SP_CFG
+    sp = Message("SolverParameter", base_lr=c["lr"], lr_policy="fixed",
+                 momentum=0.9, display=0, random_seed=0)
+    solver = SeqParallelSolver(
+        sp, mesh=make_mesh({"data": 1, "seq": 8}),
+        net_param=zoo.transformer_lm(vocab_size=c["V"], seq_len=c["S"],
+                                     batch_size=c["B"], d_model=c["D"],
+                                     num_layers=1, num_heads=2,
+                                     flash=False, ring=True))
+    rs = np.random.RandomState(0)
+    batches = []
+    for _ in range(c["steps"]):
+        toks = rs.randint(0, c["V"], (c["B"], c["S"] + 1))
+        batches.append({"data": toks[:, :-1], "label": toks[:, 1:]})
+    return solver, batches
+
+
 # a worker that joins the coordinator with a short timeout; used with one
 # process deliberately missing to exercise the dead-peer failure path
 _WORKER_DEADPEER = r"""
@@ -374,6 +426,21 @@ def test_four_process_nondivisible_batch_error(four_proc_outs):
     per = _collect(four_proc_outs, "NONDIV", n=4)
     for pid in range(4):
         assert per[pid][0] == "OK", (pid, per[pid])
+
+
+def test_two_process_seq_parallel_matches_single_process(tmp_path):
+    """A "seq" mesh axis spanning 2 real processes: ring attention's
+    ppermute crosses host boundaries and both hosts see the identical
+    loss curve — which also matches the single-process run."""
+    outs = _run_workers(_WORKER_SP, tmp_path, n=2)
+    per = _collect(outs, "SP_LOSSES")
+    np.testing.assert_allclose([float(v) for v in per[0]],
+                               [float(v) for v in per[1]], rtol=1e-5)
+
+    solver, batches = _sp_solver_and_batches()   # same config, 1 process
+    ref = [float(solver.train_step(b)) for b in batches]
+    np.testing.assert_allclose([float(v) for v in per[0]], ref,
+                               rtol=1e-3, atol=1e-4)
 
 
 def test_dead_peer_times_out_cleanly(tmp_path):
