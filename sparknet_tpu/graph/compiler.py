@@ -187,6 +187,19 @@ class CompiledNet:
                 impl = cls(lp, bshapes, phase)
             impl.compute_dtype = compute_dtype
             tshapes = impl.out_shapes()
+            if len(tops) < len(tshapes) and impl.loss_like:
+                # Caffe auto-top (net.cpp AppendTop, gated on
+                # AutoTopBlobs() == loss layers only): a LOSS layer may
+                # declare fewer tops than it produces — commonly none
+                # (pascal_finetune's SoftmaxWithLoss) — and the missing
+                # blobs get automatic names derived from the layer. For
+                # any other layer type an under-declaration stays a hard
+                # error (it is almost certainly a typo'd prototxt).
+                auto = [lp.name if len(tshapes) - len(tops) == 1
+                        else f"{lp.name}_top{i}"
+                        for i in range(len(tops), len(tshapes))]
+                tops = tops + auto
+                lp.top.extend(auto)
             if len(tshapes) != len(tops):
                 raise ValueError(
                     f"layer {lp.name!r} ({lp.type}): {len(tops)} tops declared "

@@ -112,6 +112,22 @@ class SeqParallelSolver(Solver):
 
     def train_step(self, batch):
         self.check_batch(batch, split_across_hosts=False)
+        if jax.process_count() > 1 and not getattr(self, "_feed_checked",
+                                                   False):
+            # the global-feed contract is that every host passes the SAME
+            # batch; a per-host rng would desync silently (devices pull
+            # blocks from their own host's divergent copy). One checksum
+            # agreement check on the first step surfaces it.
+            self._feed_checked = True
+            from jax.experimental import multihost_utils
+            sums = np.array([np.asarray(v, np.float64).sum()
+                             for _, v in sorted(batch.items())])
+            gathered = multihost_utils.process_allgather(sums)
+            if not np.allclose(gathered, gathered[0]):
+                raise ValueError(
+                    "SeqParallelSolver global-feed batches differ across "
+                    "hosts (first-step checksum mismatch): every host "
+                    "must construct the identical global batch")
         self.rng, key = jax.random.split(self.rng)
         with self._axes_context():
             if self._jit_train is None:

@@ -360,10 +360,15 @@ class Solver:
         # arrays, so the n eval dispatches (and their H2D feeds) pipeline;
         # the only host sync is the final fetch
         sums = None
+        # sharded solvers that re-place batches themselves (the
+        # global-feed path fetches host data per blob) skip the eager
+        # device conversion — it would only add a transfer round trip
+        to_dev = jax.process_count() == 1
         try:
             for i in range(n):
-                batch = {k: jnp.asarray(v)
-                         for k, v in next(data_iter).items()}
+                batch = next(data_iter)
+                if to_dev:
+                    batch = {k: jnp.asarray(v) for k, v in batch.items()}
                 out = self._jit_eval(self.params, self.state, batch)
                 if sums is None:
                     sums = {k: jnp.asarray(v, jnp.float32)

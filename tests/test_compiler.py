@@ -253,30 +253,51 @@ class TestV1Upgrade:
 
 # every remaining stock net prototxt in the reference tree compiles AND
 # runs one forward (the "a reference user finds everything they need" bar;
-# quick/full/caffenet/googlenet/lenet_train_test are covered above)
+# quick/full/caffenet/googlenet/lenet_train_test are covered above).
+# Second element: the feed_shapes override standing in for the prototxt's
+# data source (None = deploy net, shapes come from its `input` decl).
 _STOCK_NETS = [
     ("examples/cifar10/cifar10_full_sigmoid_train_test.prototxt",
-     (2, 3, 32, 32)),
+     {"data": (2, 3, 32, 32), "label": (2,)}),
     ("examples/cifar10/cifar10_full_sigmoid_train_test_bn.prototxt",
-     (2, 3, 32, 32)),
-    ("models/bvlc_alexnet/train_val.prototxt", (2, 3, 227, 227)),
-    ("models/finetune_flickr_style/train_val.prototxt", (2, 3, 227, 227)),
+     {"data": (2, 3, 32, 32), "label": (2,)}),
+    ("models/bvlc_alexnet/train_val.prototxt",
+     {"data": (2, 3, 227, 227), "label": (2,)}),
+    ("models/finetune_flickr_style/train_val.prototxt",
+     {"data": (2, 3, 227, 227), "label": (2,)}),
     ("examples/mnist/lenet.prototxt", None),   # deploy net: `input` blobs
+    # deploy-only R-CNN variant (fc-rcnn 200-way head on caffenet trunk)
+    ("models/bvlc_reference_rcnn_ilsvrc13/deploy.prototxt", None),
+    # HDF5Data logreg/MLP examples (4-feature vectors)
+    ("examples/hdf5_classification/train_val.prototxt",
+     {"data": (2, 4), "label": (2,)}),
+    ("examples/hdf5_classification/nonlinear_train_val.prototxt",
+     {"data": (2, 4), "label": (2,)}),
+    # siamese twins: Slice of the stacked pair + SHARED conv/fc params
+    # (`param { name: ... }` cross-layer sharing) + ContrastiveLoss
+    ("examples/siamese/mnist_siamese_train_test.prototxt",
+     {"pair_data": (2, 2, 28, 28), "sim": (2,)}),
+    ("examples/siamese/mnist_siamese.prototxt", None),
+    # WindowData fine-tuning net (window_data_param source absent ->
+    # feeds stand in, like the other data layers)
+    ("examples/finetune_pascal_detection/pascal_finetune_trainval_test"
+     ".prototxt", {"data": (2, 3, 227, 227), "label": (2,)}),
 ]
 
+_INT_FEEDS = ("label", "sim")
 
-@pytest.mark.parametrize("rel,shape", _STOCK_NETS,
+
+@pytest.mark.parametrize("rel,feed", _STOCK_NETS,
                          ids=[r.split("/")[-1] for r, _ in _STOCK_NETS])
-def test_stock_net_compiles_and_forwards(rel, shape):
+def test_stock_net_compiles_and_forwards(rel, feed):
     npm = proto.load_prototxt(f"{REF}/{rel}", "NetParameter")
-    feed = {"data": shape, "label": (shape[0],)} if shape else None
     net = CompiledNet(npm, TRAIN, feed_shapes=feed)
     params, state = net.init(jax.random.PRNGKey(0))
     rs = np.random.RandomState(0)
     batch = {}
     for name, s in net.feed_shapes().items():
         batch[name] = rs.randint(0, 2, s).astype(np.int32) \
-            if name == "label" else rs.randn(*s).astype(np.float32)
+            if name in _INT_FEEDS else rs.randn(*s).astype(np.float32)
     blobs, _ = net.apply(params, state, batch, train=False)
     for b in net.output_blobs:
         assert np.isfinite(np.asarray(blobs[b], np.float32)).all(), \
