@@ -70,12 +70,24 @@ for _n in range(256):
 del _c, _n
 
 
-def crc32c(data, crc=0):
+def _crc32c_py(data, crc=0):
     c = crc ^ 0xffffffff
     tab = _CRC_TABLE
     for b in data:
         c = tab[(c ^ b) & 0xff] ^ (c >> 8)
     return c ^ 0xffffffff
+
+
+def crc32c(data, crc=0):
+    # the native kernel (native/pipeline.cpp crc32c_update) wins past a
+    # few dozen bytes; the ctypes call itself costs ~1us, so tiny inputs
+    # (the 1-byte record-type prefixes) stay in Python
+    if len(data) >= 64:
+        from .. import native
+        c = native.crc32c(data, crc)
+        if c is not None:
+            return c
+    return _crc32c_py(data, crc)
 
 
 def crc_mask(crc):
@@ -93,8 +105,17 @@ def crc_unmask(masked):
 def snappy_decompress(data):
     """Full Snappy format decoder: varint32 length preamble, then literal
     (00), copy-1 (01), copy-2 (10), copy-4 (11) elements; copies may
-    overlap their own output (RLE-style) so those run byte-wise."""
+    overlap their own output (RLE-style) so those run byte-wise.
+
+    Dispatches to the native decoder (native/pipeline.cpp
+    snappy_uncompress) when the lazily-built library is available — the
+    block decode is the hot loop of LevelDB streaming; the pure-Python
+    path below is the always-available fallback and the executable spec."""
     n, p = _get_varint(data, 0)
+    from .. import native
+    out_native = native.snappy_uncompress(data, n)
+    if out_native is not None:
+        return out_native
     out = bytearray()
     while p < len(data):
         tag = data[p]

@@ -17,7 +17,7 @@ import numpy as np
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_DIR, "pipeline.cpp")
 _SO = os.path.join(_DIR, "libsparknet_native.so")
-_ABI = 1
+_ABI = 3
 
 _lock = threading.Lock()
 _lib = None
@@ -77,6 +77,10 @@ def _bind(lib):
     lib.decode_cifar_records.restype = None
     lib.accumulate_sum.argtypes = [u8p, i64, i64, i64p]
     lib.accumulate_sum.restype = None
+    lib.crc32c_update.argtypes = [u8p, i64, ctypes.c_uint32]
+    lib.crc32c_update.restype = ctypes.c_uint32
+    lib.snappy_uncompress.argtypes = [u8p, i64, u8p, i64]
+    lib.snappy_uncompress.restype = i64
 
 
 def available():
@@ -181,3 +185,38 @@ def accumulate_sum(images, acc):
         return acc
     acc += images.astype(np.int64).sum(axis=0)
     return acc
+
+
+def crc32c(data, crc=0):
+    """Native crc32c (Castagnoli) with the leveldb.py (data, crc)
+    semantics, or None when the native lib is unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    if not len(data):
+        return crc & 0xffffffff      # xor-in/xor-out cancel on empty input
+    buf = np.frombuffer(data, np.uint8)      # zero-copy for bytes-likes
+    return int(lib.crc32c_update(_ptr(buf, ctypes.c_uint8), len(buf), crc))
+
+
+def snappy_uncompress(data, declared_len):
+    """Decode a raw-Snappy payload to bytes via the native decoder.
+    Returns None when the lib is unavailable OR the decode fails — the
+    caller's pure-Python decoder is both the fallback and the error
+    path with the descriptive diagnostics."""
+    lib = _load()
+    if lib is None:
+        return None
+    # a corrupt preamble could claim terabytes: max snappy expansion is
+    # ~64/3 bytes out per byte in (a 3-byte copy-2 element emitting 64),
+    # so anything past 24x + slack cannot be a valid stream
+    if declared_len < 0 or declared_len > len(data) * 24 + 64:
+        return None
+    src = np.frombuffer(data, np.uint8)      # zero-copy for bytes-likes
+    out = np.empty(declared_len, np.uint8)
+    got = lib.snappy_uncompress(
+        _ptr(src, ctypes.c_uint8), len(src),
+        _ptr(out, ctypes.c_uint8), declared_len)
+    if got != declared_len:
+        return None
+    return out.tobytes()

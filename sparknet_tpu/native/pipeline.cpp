@@ -107,6 +107,105 @@ void accumulate_sum(const uint8_t* in, int64_t n, int64_t chw,
   }
 }
 
-int native_abi_version() { return 2; }
+// crc32c (Castagnoli), same (data, crc) semantics as the Python
+// reference in data/leveldb.py: init/final xor inside, so chained calls
+// pass the previous RESULT as crc. These entry points run GIL-released
+// from multiple prefetch threads, so the table uses a C++11 magic
+// static (guaranteed race-free one-time init).
+struct Crc32cTable {
+    uint32_t tab[256];
+    Crc32cTable() {
+        for (uint32_t n = 0; n < 256; n++) {
+            uint32_t c = n;
+            for (int k = 0; k < 8; k++)
+                c = (c >> 1) ^ ((c & 1) ? 0x82f63b78u : 0u);
+            tab[n] = c;
+        }
+    }
+};
+
+static const uint32_t* crc32c_table() {
+    static const Crc32cTable t;
+    return t.tab;
+}
+
+uint32_t crc32c_update(const uint8_t* data, int64_t len, uint32_t crc) {
+    const uint32_t* tab = crc32c_table();
+    uint32_t c = crc ^ 0xffffffffu;
+    for (int64_t i = 0; i < len; i++)
+        c = tab[(c ^ data[i]) & 0xff] ^ (c >> 8);
+    return c ^ 0xffffffffu;
+}
+
+// Snappy raw-format decode (the LevelDB block codec): varint32 length
+// preamble then literal/copy elements. Returns the decoded length, or
+// -1 on malformed/overrunning input (callers fall back to the Python
+// decoder, which raises a descriptive error). `out` must hold the
+// preamble-declared length; overlapping copies run byte-wise (RLE).
+int64_t snappy_uncompress(const uint8_t* in, int64_t in_len,
+                          uint8_t* out, int64_t out_cap) {
+    int64_t p = 0, o = 0;
+    // varint32 preamble
+    uint32_t declared = 0;
+    int shift = 0;
+    while (true) {
+        if (p >= in_len || shift > 28) return -1;
+        uint8_t b = in[p++];
+        declared |= (uint32_t)(b & 0x7f) << shift;
+        if (!(b & 0x80)) break;
+        shift += 7;
+    }
+    if ((int64_t)declared != out_cap) return -1;
+    while (p < in_len) {
+        uint8_t tag = in[p++];
+        int kind = tag & 3;
+        if (kind == 0) {                       // literal
+            int64_t ln = tag >> 2;
+            if (ln >= 60) {                    // length in 1-4 bytes
+                int nb = (int)(ln - 59);
+                if (p + nb > in_len) return -1;
+                ln = 0;
+                for (int i = 0; i < nb; i++)
+                    ln |= (int64_t)in[p + i] << (8 * i);
+                p += nb;
+            }
+            ln += 1;
+            if (p + ln > in_len || o + ln > out_cap) return -1;
+            std::memcpy(out + o, in + p, (size_t)ln);
+            p += ln;
+            o += ln;
+            continue;
+        }
+        int64_t ln, off;
+        if (kind == 1) {                       // copy, 1-byte offset
+            if (p >= in_len) return -1;
+            ln = ((tag >> 2) & 0x7) + 4;
+            off = ((int64_t)(tag >> 5) << 8) | in[p];
+            p += 1;
+        } else if (kind == 2) {                // copy, 2-byte offset
+            if (p + 2 > in_len) return -1;
+            ln = (tag >> 2) + 1;
+            off = (int64_t)in[p] | ((int64_t)in[p + 1] << 8);
+            p += 2;
+        } else {                               // copy, 4-byte offset
+            if (p + 4 > in_len) return -1;
+            ln = (tag >> 2) + 1;
+            off = (int64_t)in[p] | ((int64_t)in[p + 1] << 8)
+                | ((int64_t)in[p + 2] << 16) | ((int64_t)in[p + 3] << 24);
+            p += 4;
+        }
+        if (off <= 0 || off > o || o + ln > out_cap) return -1;
+        int64_t start = o - off;
+        if (off >= ln) {
+            std::memcpy(out + o, out + start, (size_t)ln);
+        } else {
+            for (int64_t i = 0; i < ln; i++) out[o + i] = out[start + i];
+        }
+        o += ln;
+    }
+    return o == out_cap ? o : -1;
+}
+
+int native_abi_version() { return 3; }
 
 }  // extern "C"

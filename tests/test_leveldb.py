@@ -53,6 +53,48 @@ def test_snappy_copy_elements():
     assert snappy_decompress(bytes(s)) == b"1234567812345"
 
 
+def test_snappy_native_matches_python_fallback(monkeypatch):
+    """The C++ decoder (native/pipeline.cpp snappy_uncompress) and the
+    pure-Python spec must agree byte-for-byte, including copy elements
+    and real-snappy streams our own compressor never emits."""
+    from sparknet_tpu import native
+    if not native.available():
+        pytest.skip("native lib unavailable")
+
+    def enc_preamble(n):
+        buf = bytearray()
+        ldb._put_varint(buf, n)
+        return buf
+
+    payloads = [snappy_compress(b""), snappy_compress(b"x"),
+                snappy_compress(os.urandom(70000)),
+                bytes(enc_preamble(8) + bytes([3 << 2]) + b"abcd"
+                      + bytes([1 | (0 << 2), 4])),
+                bytes(enc_preamble(8) + bytes([1 << 2]) + b"ab"
+                      + bytes([1 | (2 << 2), 2])),
+                bytes(enc_preamble(13) + bytes([7 << 2]) + b"12345678"
+                      + bytes([2 | (4 << 2)]) + struct.pack("<H", 8)),
+                bytes(enc_preamble(13) + bytes([7 << 2]) + b"12345678"
+                      + bytes([3 | (4 << 2)]) + struct.pack("<I", 8))]
+    native_out = [snappy_decompress(p) for p in payloads]
+    monkeypatch.setattr(native, "snappy_uncompress",
+                        lambda data, n: None)       # force Python path
+    python_out = [snappy_decompress(p) for p in payloads]
+    assert native_out == python_out
+
+
+def test_crc32c_native_matches_python():
+    from sparknet_tpu import native
+    if not native.available():
+        pytest.skip("native lib unavailable")
+    for payload in (b"", b"a" * 64, os.urandom(1000), os.urandom(65536)):
+        got = native.crc32c(payload, 0)
+        assert got == ldb._crc32c_py(payload, 0)
+        # chained (data, crc) semantics must match too
+        assert native.crc32c(payload, 12345) == ldb._crc32c_py(payload,
+                                                               12345)
+
+
 def test_snappy_length_mismatch_raises():
     bad = bytearray(snappy_compress(b"abc"))
     bad[0] = 5                                # claim 5, produce 3
