@@ -299,19 +299,33 @@ class CompiledNet:
         return out
 
     # -- forward -----------------------------------------------------------
-    def apply(self, params, state, batch, train=None, rng=None):
-        """Run the forward pass. Pure; jit/grad-safe."""
-        if train is None:
-            train = (self.phase == TRAIN)
-        if rng is None:
-            rng = jax.random.PRNGKey(0)
-        from . import fission
-        fiss = fission.enabled()
-        blobs = {}
-        for n in self.net_inputs:
-            blobs[n] = jnp.asarray(batch[n])
-        new_state = dict(state)
+    def _remat_groups(self):
+        """Rematerialization segments: maximal runs of >= 2 consecutive
+        layers sharing a name prefix before "/" (the zoo's "block{i}/..."
+        convention). Cached; used only when SPARKNET_REMAT is on."""
+        if getattr(self, "_remat_cache", None) is not None:
+            return self._remat_cache
+        groups = {}
+        start = None
+        prefix = None
         for li, (lp, impl, bottoms, tops) in enumerate(self.layers):
+            p = lp.name.split("/")[0] if "/" in lp.name else None
+            if p != prefix:
+                if prefix is not None and li - start >= 2:
+                    groups[start] = li
+                start, prefix = li, p
+        if prefix is not None and len(self.layers) - start >= 2:
+            groups[start] = len(self.layers)
+        self._remat_cache = groups
+        return groups
+
+    def _apply_range(self, params, state, new_state, blobs, lo, hi, batch,
+                     train, rng, fiss):
+        """Run layers [lo, hi) over the mutable blob dict (the body the
+        remat segments replay)."""
+        from . import fission
+        for li in range(lo, hi):
+            lp, impl, bottoms, tops = self.layers[li]
             if getattr(impl, "is_feed", False):
                 for t in tops:
                     blobs[t] = jnp.asarray(batch[t])
@@ -332,6 +346,88 @@ class CompiledNet:
                     tvals = impl.apply(lparams, bvals, train, lrng)
             for t, v in zip(tops, tvals):
                 blobs[t] = v
+
+    def _segment_externals(self, lo, hi):
+        """Blob names a [lo, hi) segment must surface: consumed by later
+        layers, carrying loss weight, or net outputs."""
+        produced = set()
+        for li in range(lo, hi):
+            produced.update(self.layers[li][3])
+        needed = set()
+        for li in range(hi, len(self.layers)):
+            needed.update(self.layers[li][2])
+        for li in range(lo, hi):
+            lp = self.layers[li][0]
+            for t, w in zip(self.layers[li][3], self.loss_weights[lp.name]):
+                if w:
+                    needed.add(t)
+        needed.update(self.output_blobs)
+        return sorted(produced & needed)
+
+    def apply(self, params, state, batch, train=None, rng=None):
+        """Run the forward pass. Pure; jit/grad-safe.
+
+        With SPARKNET_REMAT=1 and train=True, runs of layers sharing a
+        "prefix/" name (the zoo's per-block convention) execute under
+        jax.checkpoint: the backward pass recomputes their internals
+        instead of saving every intermediate activation — the standard
+        TPU memory/FLOPs trade for deep transformers. Segment-INTERNAL
+        blobs are then absent from the returned dict (only segment
+        boundaries, loss tops and net outputs survive), which training
+        never reads; keep remat off for extract_features-style blob
+        inspection."""
+        if train is None:
+            train = (self.phase == TRAIN)
+        if rng is None:
+            rng = jax.random.PRNGKey(0)
+        import os
+        from . import fission
+        fiss = fission.enabled()
+        remat = train and os.environ.get("SPARKNET_REMAT", "0") == "1"
+        groups = self._remat_groups() if remat else {}
+        blobs = {}
+        for n in self.net_inputs:
+            blobs[n] = jnp.asarray(batch[n])
+        new_state = dict(state)
+        li = 0
+        while li < len(self.layers):
+            hi = groups.get(li)
+            if hi is None:
+                self._apply_range(params, state, new_state, blobs,
+                                  li, li + 1, batch, train, rng, fiss)
+                li += 1
+                continue
+            # remat segment [li, hi): close over statics, checkpoint the
+            # array-valued computation
+            lo = li
+            in_names = sorted({b for j in range(lo, hi)
+                               for b in self.layers[j][2] if b in blobs})
+            out_names = self._segment_externals(lo, hi)
+            seg_states = sorted({self.layers[j][0].name
+                                 for j in range(lo, hi)
+                                 if self.layers[j][1].has_state})
+
+            @jax.checkpoint
+            def seg_fn(params, state, in_vals, rng, lo=lo, hi=hi,
+                       in_names=in_names, out_names=out_names,
+                       seg_states=seg_states):
+                sblobs = {n: fission.materialize(v)
+                          for n, v in zip(in_names, in_vals)}
+                sstate = dict(state)
+                self._apply_range(params, state, sstate, sblobs,
+                                  lo, hi, batch, train, rng, fiss)
+                return ([fission.materialize(sblobs[n])
+                         for n in out_names],
+                        [sstate[n] for n in seg_states])
+
+            out_vals, out_states = seg_fn(
+                params, state,
+                [fission.materialize(blobs[n]) for n in in_names], rng)
+            for n, v in zip(out_names, out_vals):
+                blobs[n] = v
+            for n, st in zip(seg_states, out_states):
+                new_state[n] = st
+            li = hi
         # callers see arrays only; unconsumed materializations are DCE'd
         return {k: fission.materialize(v) for k, v in blobs.items()}, \
             new_state
