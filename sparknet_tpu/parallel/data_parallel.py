@@ -277,8 +277,12 @@ class LocalSGDSolver(Solver):
         axis, tau = self.axis, self.tau
         unroll = self.unroll
         if unroll is None:
-            unroll = tau if all(d.platform == "cpu"
-                                for d in self.mesh.devices.flat) else 1
+            # 0 = fully unroll regardless of tau. unroll=tau would seem
+            # equivalent but lowers tau==1 through the While path (jax
+            # excludes unroll==1 from its full-unroll shortcut), which
+            # XLA:CPU pessimizes ~10x like any conv-in-loop
+            unroll = 0 if all(d.platform == "cpu"
+                              for d in self.mesh.devices.flat) else 1
         average_history = self.average_history
         loss_fn = self._wrapped_loss(net)
 
