@@ -1,4 +1,4 @@
-"""Interleaved A/B: stem-conv space-to-depth on/off (CaffeNet/GoogLeNet)."""
+"""Interleaved A/B: SPARKNET_LRN=xla vs pallas fused LRN (CaffeNet/GoogLeNet)."""
 import json
 import os
 import sys

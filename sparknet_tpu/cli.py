@@ -15,8 +15,17 @@ Signal semantics follow the reference flags -sigint_effect/-sighup_effect
 
 import argparse
 import json
+import os
 import sys
 import time
+
+# Honor JAX_PLATFORMS for every verb: deployment sitecustomize modules may
+# force-register an accelerator platform and override the env var's effect
+# (see tests/conftest.py) — "JAX_PLATFORMS=cpu sparknet lm --ep 4" on a
+# virtual CPU mesh must still work on such hosts.
+if os.environ.get("JAX_PLATFORMS"):
+    import jax
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 
 
 def _mesh_arg(s):
@@ -463,7 +472,51 @@ def cmd_lm(args):
     print(f"bigram corpus floor: {floor:.4f} nats/token "
           f"(untrained: {np.log(args.vocab):.4f})")
 
-    if args.pipeline_stages > 1:
+    if args.ep > 1 or args.dp > 1:
+        # dp x ep: ExpertParallelSolver (expert weights + optimizer state
+        # sharded over "expert", batch over both axes, all_to_all dispatch)
+        if args.pipeline_stages > 1:
+            raise SystemExit("--ep/--dp cannot combine with "
+                             "--pipeline-stages")
+        if not args.moe_experts:
+            raise SystemExit("--ep/--dp need --moe-experts")
+        from .parallel import ExpertParallelSolver, make_mesh
+        from .models import zoo
+        net = zoo.transformer_lm(num_layers=args.layers,
+                                 moe_experts=args.moe_experts,
+                                 moe_aux_weight=args.moe_aux_weight,
+                                 moe_stats=True, **lm_kw)
+        solver = ExpertParallelSolver(
+            sp, mesh=make_mesh({"data": args.dp, "expert": args.ep}),
+            net_param=net, metrics=metrics, dtype=dtype,
+            compute_dtype=compute_dtype)
+        if args.resume:
+            solver.restore(args.resume)
+        start_iter = solver.iter
+        t0 = _time.time()
+        chunk = args.display or 50
+        while solver.iter < args.steps:
+            solver.step(min(chunk, args.steps - solver.iter), stream)
+            # routing diagnostics: one TEST-phase forward; the stats tops
+            # (per-expert token fractions + overflow) pmean'd over the mesh
+            scores = solver.test(iter([next(stream)]), num_iters=1)
+            stats = {k: np.asarray(v) for k, v in scores.items()
+                     if k.endswith("/moe_stats")}
+            if stats:
+                util = np.mean([s[:-1] for s in stats.values()], axis=0)
+                overflow = float(np.mean([s[-1] for s in stats.values()]))
+                print(f"    iter {solver.iter}: expert util "
+                      f"[{', '.join(f'{u:.3f}' for u in util)}] "
+                      f"overflow {overflow:.4f}")
+                if metrics:
+                    metrics.log("moe", iter=solver.iter,
+                                expert_util=[round(float(u), 4)
+                                             for u in util],
+                                overflow_fraction=round(overflow, 5),
+                                **{k.replace("/moe_stats", "_util"):
+                                   [round(float(x), 4) for x in s[:-1]]
+                                   for k, s in stats.items()})
+    elif args.pipeline_stages > 1:
         from .parallel import PipelineLMSolver, make_mesh
         if args.moe_experts:
             raise SystemExit("--moe-experts is not supported under "
@@ -484,7 +537,9 @@ def cmd_lm(args):
         from .solver.solver import Solver
         from .models import zoo
         net = zoo.transformer_lm(num_layers=args.layers,
-                                 moe_experts=args.moe_experts, **lm_kw)
+                                 moe_experts=args.moe_experts,
+                                 moe_aux_weight=args.moe_aux_weight,
+                                 **lm_kw)
         solver = Solver(sp, net_param=net, metrics=metrics, dtype=dtype,
                         compute_dtype=compute_dtype)
         if args.resume:
@@ -684,6 +739,14 @@ def main(argv=None):
     lm.add_argument("--no-flash", action="store_true",
                     help="dense attention instead of the pallas kernel")
     lm.add_argument("--moe-experts", type=int, default=0)
+    lm.add_argument("--moe-aux-weight", type=float, default=0.01,
+                    help="Switch load-balancing aux loss weight")
+    lm.add_argument("--ep", type=int, default=1,
+                    help="N>1: ExpertParallelSolver over an N-way "
+                         "\"expert\" mesh axis (needs --moe-experts)")
+    lm.add_argument("--dp", type=int, default=1,
+                    help="data-parallel ways composed with --ep "
+                         "(mesh {data: dp, expert: ep})")
     lm.add_argument("--pipeline-stages", type=int, default=1,
                     help="N>1: run the trunk as an N-stage GPipe pipeline "
                          "over a pipe mesh axis (PipelineLMSolver)")

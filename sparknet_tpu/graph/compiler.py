@@ -423,6 +423,13 @@ class CompiledNet:
             out_vals, out_states = seg_fn(
                 params, state,
                 [fission.materialize(blobs[n]) for n in in_names], rng)
+            # a blob produced before the segment and overwritten in-place
+            # inside it (top==bottom across the boundary) must not survive
+            # with its stale pre-segment value — internal blobs are ABSENT,
+            # never wrong
+            produced = {t for j in range(lo, hi) for t in self.layers[j][3]}
+            for n in produced.difference(out_names):
+                blobs.pop(n, None)
             for n, v in zip(out_names, out_vals):
                 blobs[n] = v
             for n, st in zip(seg_states, out_states):

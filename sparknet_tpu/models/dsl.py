@@ -151,9 +151,11 @@ def PositionalEmbedLayer(name, bottoms, max_positions, num_output,
 
 def MoELayer(name, bottoms, num_experts, hidden_dim=None,
              capacity_factor=None, expert_parallel=False,
-             aux_loss_weight=None, weight_filler=None):
+             aux_loss_weight=None, weight_filler=None, stats=False):
     """sparknet_tpu extension: Switch-style MoE FFN. aux_loss_weight adds a
-    second top carrying the load-balancing loss with that loss_weight."""
+    second top carrying the load-balancing loss with that loss_weight;
+    stats=True adds a third (weight-0) diagnostics top with per-expert
+    token fractions + the overflow fraction."""
     mp = dict(num_experts=num_experts, expert_parallel=expert_parallel)
     if hidden_dim is not None:
         mp["hidden_dim"] = hidden_dim
@@ -161,10 +163,15 @@ def MoELayer(name, bottoms, num_experts, hidden_dim=None,
         mp["capacity_factor"] = capacity_factor
     if weight_filler is not None:
         mp["weight_filler"] = weight_filler
+    if stats and aux_loss_weight is None:
+        aux_loss_weight = 0.0          # stats is top 3; aux must exist
     tops = [name] if aux_loss_weight is None else [name, f"{name}_aux"]
+    if stats:
+        tops.append(f"{name}_stats")
     lp = _base("MoE", name, bottoms, tops=tops, moe_param=mp)
     if aux_loss_weight is not None:
-        lp.loss_weight.extend([0.0, float(aux_loss_weight)])
+        lp.loss_weight.extend([0.0, float(aux_loss_weight)]
+                              + ([0.0] if stats else []))
     return lp
 
 

@@ -263,7 +263,8 @@ def googlenet(batch_size=32, num_classes=1000, with_data=True,
 def transformer_lm(vocab_size=512, seq_len=256, batch_size=8, d_model=256,
                    num_layers=4, num_heads=8, d_ff=None, max_positions=None,
                    flash=True, ring=False, with_data=True, moe_experts=0,
-                   moe_aux_weight=0.01):
+                   moe_aux_weight=0.01, moe_capacity_factor=None,
+                   moe_stats=False):
     """Decoder-only causal transformer LM — the long-context model family.
 
     No CNN-era reference twin (SURVEY.md section 5: the reference has no
@@ -307,7 +308,9 @@ def transformer_lm(vocab_size=512, seq_len=256, batch_size=8, d_model=256,
             layers += [
                 MoELayer(f"{p}/moe", [f"{p}/ln2"], moe_experts,
                          hidden_dim=d_ff, expert_parallel=True,
-                         aux_loss_weight=moe_aux_weight),
+                         aux_loss_weight=moe_aux_weight,
+                         capacity_factor=moe_capacity_factor,
+                         stats=moe_stats),
                 EltwiseLayer(f"{p}/res2", [f"{p}/res1", f"{p}/moe"]),
             ]
         else:

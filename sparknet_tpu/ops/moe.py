@@ -102,6 +102,10 @@ class MoE(Layer):
         shapes = [tuple(self.bottom_shapes[0])]
         if len(self.lp.top) > 1:
             shapes.append(())                     # aux load-balancing loss
+        if len(self.lp.top) > 2:
+            # routing diagnostics (stop-gradient): per-expert token
+            # fractions + the overflow (dropped-token) fraction
+            shapes.append((self.num_experts + 1,))
         return shapes
 
     def apply(self, params, bottoms, train, rng):
@@ -171,4 +175,11 @@ class MoE(Layer):
                             axis=0)
             tops.append(jnp.asarray(X, jnp.float32)
                         * jnp.sum(frac * jnp.mean(gates, axis=0)))
+            if len(self.lp.top) > 2:
+                # diagnostics top [frac_0..frac_{X-1}, overflow_fraction]
+                # — LOCAL statistics (this shard's tokens); training
+                # drivers pmean/log them per step
+                overflow = 1.0 - jnp.mean(keep_s.astype(jnp.float32))
+                tops.append(lax.stop_gradient(
+                    jnp.concatenate([frac, overflow[None]])))
         return tops

@@ -27,6 +27,7 @@ __all__ = [
     "axis_context", "current_axes", "context",
     "DataParallelSolver", "LocalSGDSolver", "shard_batch",
     "GSPMDSolver", "default_param_rule", "SeqParallelSolver",
+    "ExpertParallelSolver",
     "ring_attention", "ulysses_attention", "sequence_sharded_apply",
     "gpipe", "pipeline_apply", "stack_params", "PipelineLMSolver",
 ]
@@ -42,6 +43,7 @@ _EXPORTS = {
     "shard_batch": "data_parallel",
     "GSPMDSolver": "gspmd", "default_param_rule": "gspmd",
     "SeqParallelSolver": "seq_parallel",
+    "ExpertParallelSolver": "expert_parallel",
     "ring_attention": "ring", "ulysses_attention": "ring",
     "sequence_sharded_apply": "ring",
     "gpipe": "pipeline", "pipeline_apply": "pipeline",

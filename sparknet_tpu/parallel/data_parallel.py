@@ -71,6 +71,25 @@ def shard_batch(batch, mesh, axis=DATA_AXIS, batch_dim=0, seq_axis=None,
     return out
 
 
+def check_global_feed(batch):
+    """First-step agreement check for the global-feed discipline (every
+    host passes the SAME full batch; devices pull their own blocks): a
+    per-host rng would desync silently — devices would pull blocks from
+    their own host's divergent copy — so one cross-host checksum
+    comparison surfaces it. Call once, on the first fed batch."""
+    if jax.process_count() <= 1:
+        return
+    from jax.experimental import multihost_utils
+    sums = np.array([np.asarray(v, np.float64).sum()
+                     for _, v in sorted(batch.items())])
+    gathered = multihost_utils.process_allgather(sums)
+    if not np.allclose(gathered, gathered[0]):
+        raise ValueError(
+            "global-feed batches differ across hosts (first-step "
+            "checksum mismatch): every host must construct the identical "
+            "global batch")
+
+
 def _rebatch(net, n, seq=1):
     """Compile a per-shard twin of ``net``: identical params/layers and
     precision, feed blobs with leading (batch) dim divided by ``n`` (and,
@@ -86,11 +105,12 @@ def _rebatch(net, n, seq=1):
                 f"feed blob {name!r} batch {s[0]} not divisible by mesh "
                 f"axis size {n}")
         out = [s[0] // n] + list(s[1:])
-        if seq > 1:
-            if len(s) < 2 or s[1] % seq:
+        if seq > 1 and len(s) >= 2:
+            # rank-1 (per-example) blobs need no sequence shard: _one_spec
+            # already leaves them replicated along the seq axis
+            if s[1] % seq:
                 raise ValueError(
-                    f"feed blob {name!r} seq dim "
-                    f"{s[1] if len(s) > 1 else '<missing>'} not divisible "
+                    f"feed blob {name!r} seq dim {s[1]} not divisible "
                     f"by seq axis size {seq}")
             out[1] = s[1] // seq
         local[name] = tuple(out)

@@ -1,0 +1,228 @@
+"""dp x ep solver: data parallelism composed with expert parallelism.
+
+The MoE training runner: batch dim sharded over the full ("data",
+"expert") mesh — so tokens arrive SHARDED along the expert axis and
+ops.moe's all_to_all path shards expert COMPUTE ep-fold, not just weight
+memory (ops/moe.py:27-43) — while each MoE layer's expert-major weight
+blobs (w1/b1/w2/b2, dim 0 = num_experts) live sharded P("expert"), each
+device holding and updating only its own experts' slices (optimizer
+history included, ZeRO-style for the expert weights). The router stays
+replicated: every token computes all num_experts logits before dispatch.
+
+Gradient semantics (the part that makes the update equal single-device
+training on the global batch): the local loss is the mean over this
+device's 1/(dp*ep) token slice, so
+
+  * replicated params (router, attention, embeddings...): grads pmean'd
+    over BOTH axes == the global-batch gradient (every token's
+    contribution appears on exactly one device);
+  * expert-sharded params: each expert's gradient contributions appear
+    only on the ep-column that owns it (the backward all_to_all routes
+    them home), summed over that column's ep peers already — so the
+    correct reduction is pmean over "data" DIVIDED by ep (a psum over
+    "data" scaled by the global 1/(dp*ep) loss normalization).
+    tests/test_expert_parallel.py asserts the resulting loss curve
+    equals the single-device run's exactly (no-overflow capacity).
+
+The Switch aux loss is computed from LOCAL routing statistics and
+pmean'd — mean-of-products, not the product of global means. That is the
+standard data-parallel MoE formulation (each shard balances its own
+routing); with aux weight 0 the step is bit-equivalent to single-device.
+
+No reference twin: SURVEY.md section 2c lists EP/MoE as absent from the
+CNN-era reference; this solver completes the dp/tp/sp/ep/pp set with the
+same Solver API as the other axes.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..solver.solver import Solver
+from .data_parallel import _rebatch, _batch_specs, shard_batch, \
+    check_global_feed
+from . import context
+
+
+class ExpertParallelSolver(Solver):
+    """Solver whose step runs under shard_map over ("data", "expert"):
+    batch dim 0 sharded over both axes, MoE expert weights sharded over
+    "expert", everything else replicated; see module docstring for the
+    gradient reductions."""
+
+    # MoE param blob order: router, w1, b1, w2, b2 (ops/moe.py
+    # param_shapes); slot 0 (router) is replicated, 1-4 expert-sharded
+    _EXPERT_SLOTS = (1, 2, 3, 4)
+
+    def __init__(self, solver_param, mesh=None, data_axis="data",
+                 expert_axis="expert", **kw):
+        from .mesh import make_mesh
+        if jax.process_count() > 1 and int(solver_param.random_seed) < 0:
+            raise ValueError(
+                "multi-process ExpertParallelSolver requires an explicit "
+                "SolverParameter.random_seed: hosts must agree on param "
+                "init and rng streams")
+        self.mesh = mesh if mesh is not None else \
+            make_mesh({data_axis: 1, expert_axis: -1})
+        self.data_axis, self.expert_axis = data_axis, expert_axis
+        if int(solver_param.iter_size) > 1:
+            raise ValueError("ExpertParallelSolver does not support "
+                             "iter_size > 1")
+        super().__init__(solver_param, **kw)
+        dp = self.mesh.shape[data_axis]
+        self.ep = ep = self.mesh.shape[expert_axis]
+        self.local_net = _rebatch(self.net, dp * ep)
+        self.local_test_net = _rebatch(self.test_net, dp * ep) \
+            if self.test_net is not None else None
+        # per-param sharding specs ({layer: [spec per owned blob]}) + the
+        # matching bool tree used to pick the gradient reduction
+        self._param_specs, self._expert_flags = self._build_specs()
+        self._history_specs = {
+            ln: [[spec] * len(self.history[ln][i])
+                 for i, spec in enumerate(specs)]
+            for ln, specs in self._param_specs.items()}
+        # place params/history on the mesh once at init (expert blobs
+        # sharded, the rest replicated); donation keeps them resident
+        self.params = self._place(self.params, self._param_specs)
+        self.history = self._place(self.history, self._history_specs)
+
+    def _build_specs(self):
+        ea = self.expert_axis
+        specs, flags = {}, {}
+        by_name = {lp.name: (lp, impl)
+                   for lp, impl, _, _ in self.net.layers}
+        for lname, blobs in self.params.items():
+            lp, impl = by_name[lname]
+            shard = lp.type == "MoE" and getattr(impl, "expert_parallel",
+                                                 False)
+            if shard and self.ep > 1 and \
+                    impl.num_experts % self.ep:
+                raise ValueError(
+                    f"{lname}: num_experts {impl.num_experts} not "
+                    f"divisible by expert axis size {self.ep}")
+            specs[lname] = [
+                P(ea) if shard and i in self._EXPERT_SLOTS else P()
+                for i in range(len(blobs))]
+            flags[lname] = [shard and i in self._EXPERT_SLOTS
+                            for i in range(len(blobs))]
+        return specs, flags
+
+    def _place(self, tree, specs):
+        multihost = jax.process_count() > 1
+
+        def put(x, spec):
+            sh = NamedSharding(self.mesh, spec)
+            if multihost:
+                arr = np.asarray(x)
+                return jax.make_array_from_callback(
+                    arr.shape, sh, lambda idx, a=arr: a[idx])
+            return jax.device_put(x, sh)
+
+        return jax.tree_util.tree_map(put, tree, specs)
+
+    def _axes_context(self):
+        return context.axis_context(data=self.data_axis,
+                                    expert=self.expert_axis)
+
+    def _batch_spec(self, batch):
+        return _batch_specs(batch, (self.data_axis, self.expert_axis))
+
+    def _sharded_step(self, batch_example):
+        net, updater, lr_fn = self.local_net, self.updater, self.lr_fn
+        da, ea, ep = self.data_axis, self.expert_axis, self.ep
+        flags = self._expert_flags
+        loss_fn = self._wrapped_loss(net)
+
+        def reduce_grads(grads):
+            def red(g, is_expert):
+                if is_expert:
+                    # contributions for this column's experts, summed over
+                    # its ep peers by the backward all_to_all; see module
+                    # docstring for the 1/ep factor
+                    return jax.lax.pmean(g, da) / ep
+                return jax.lax.pmean(jax.lax.pmean(g, ea), da)
+            return jax.tree_util.tree_map(red, grads, flags)
+
+        def step(params, state, history, batch, it, rng):
+            flat_idx = jax.lax.axis_index(da) * jax.lax.axis_size(ea) \
+                + jax.lax.axis_index(ea)
+            rng = jax.random.fold_in(rng, flat_idx)
+
+            def lf(p):
+                loss, (blobs, new_state) = loss_fn(p, state, batch, rng)
+                return loss, new_state
+            (loss, state), grads = jax.value_and_grad(
+                lf, has_aux=True)(params)
+            grads = reduce_grads(grads)
+            loss = jax.lax.pmean(jax.lax.pmean(loss, ea), da)
+            state = jax.lax.pmean(jax.lax.pmean(state, ea), da)
+            params, history = updater(params, grads, history, lr_fn(it), it)
+            return params, state, history, loss, it + 1
+
+        bspec = self._batch_spec(batch_example)
+        pspec, hspec = self._param_specs, self._history_specs
+        sharded = jax.shard_map(
+            step, mesh=self.mesh,
+            in_specs=(pspec, P(), hspec, bspec, P(), P()),
+            out_specs=(pspec, P(), hspec, P(), P()),
+            check_vma=False)
+        return jax.jit(sharded, donate_argnums=(0, 1, 2))
+
+    def _build_train_step(self):
+        return None              # built lazily on the first batch
+
+    def _shard(self, batch):
+        return shard_batch(batch, self.mesh,
+                           (self.data_axis, self.expert_axis),
+                           global_feed=True)
+
+    def train_step(self, batch):
+        import time as _time
+        self.check_batch(batch, split_across_hosts=False)
+        if not getattr(self, "_feed_checked", False):
+            self._feed_checked = True
+            check_global_feed(batch)
+        self.rng, key = jax.random.split(self.rng)
+        t0 = _time.perf_counter()
+        with self._axes_context():
+            if self._jit_train is None:
+                self._jit_train = self._sharded_step(batch)
+            dev = self._shard(batch)
+            if self._it_dev is None:
+                self._it_dev = jnp.asarray(self.iter, jnp.int32)
+            (self.params, self.state, self.history, loss,
+             self._it_dev) = self._jit_train(
+                self.params, self.state, self.history, dev,
+                self._it_dev, key)
+        self.iter += 1
+        self._timing["train_step"] += _time.perf_counter() - t0
+        return loss
+
+    def _build_eval_step(self):
+        net = self.local_test_net
+        da, ea = self.data_axis, self.expert_axis
+        tf = self.test_input_transform
+        compiled = {}
+
+        def ev(params, state, batch):
+            if tf is not None:
+                batch = tf(batch)
+            blobs, _ = net.apply(params, state, batch, train=False)
+            return {b: jax.lax.pmean(jax.lax.pmean(
+                jnp.asarray(blobs[b], jnp.float32), ea), da)
+                    for b in net.output_blobs}
+
+        def stepper(params, state, batch):
+            key = tuple(sorted((k, tuple(np.shape(v)))
+                               for k, v in batch.items()))
+            with self._axes_context():
+                if key not in compiled:
+                    bspec = self._batch_spec(batch)
+                    compiled[key] = jax.jit(jax.shard_map(
+                        ev, mesh=self.mesh,
+                        in_specs=(self._param_specs, P(), bspec),
+                        out_specs=P(), check_vma=False))
+                return compiled[key](params, state, self._shard(batch))
+
+        return stepper

@@ -26,7 +26,8 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from ..solver.solver import Solver
-from .data_parallel import _rebatch, _batch_specs, shard_batch
+from .data_parallel import _rebatch, _batch_specs, shard_batch, \
+    check_global_feed
 from . import context
 
 
@@ -60,6 +61,21 @@ class SeqParallelSolver(Solver):
             raise ValueError("SeqParallelSolver does not support "
                              "iter_size > 1")
         super().__init__(solver_param, **kw)
+        # the exactness contract (pmean of per-shard means == global mean)
+        # requires every shard to normalize by the same token count; a loss
+        # with ignore_label normalizes by its LOCAL valid count, so shards
+        # with more padding would weigh their tokens more — silently biased
+        # gradients. Refuse rather than mis-train.
+        for lp, impl, _, _ in self.net.layers:
+            if getattr(impl, "ignore_label", None) is not None and \
+                    self.net.loss_weights.get(lp.name) and \
+                    any(self.net.loss_weights[lp.name]):
+                raise ValueError(
+                    f"layer {lp.name!r}: ignore_label losses normalize by "
+                    "the per-shard valid-token count, which breaks "
+                    "SeqParallelSolver's equal-shard loss/grad exactness "
+                    "(shards with more padding would be over-weighted). "
+                    "Drop ignore_label or mask labels on the host instead.")
         dp = self.mesh.shape[data_axis]
         sp = self.mesh.shape[seq_axis]
         self.local_net = _rebatch(self.net, dp, seq=sp)
@@ -111,23 +127,12 @@ class SeqParallelSolver(Solver):
                            seq_axis=self.seq_axis, global_feed=True)
 
     def train_step(self, batch):
+        import time as _time
         self.check_batch(batch, split_across_hosts=False)
-        if jax.process_count() > 1 and not getattr(self, "_feed_checked",
-                                                   False):
-            # the global-feed contract is that every host passes the SAME
-            # batch; a per-host rng would desync silently (devices pull
-            # blocks from their own host's divergent copy). One checksum
-            # agreement check on the first step surfaces it.
+        t0 = _time.perf_counter()
+        if not getattr(self, "_feed_checked", False):
             self._feed_checked = True
-            from jax.experimental import multihost_utils
-            sums = np.array([np.asarray(v, np.float64).sum()
-                             for _, v in sorted(batch.items())])
-            gathered = multihost_utils.process_allgather(sums)
-            if not np.allclose(gathered, gathered[0]):
-                raise ValueError(
-                    "SeqParallelSolver global-feed batches differ across "
-                    "hosts (first-step checksum mismatch): every host "
-                    "must construct the identical global batch")
+            check_global_feed(batch)
         self.rng, key = jax.random.split(self.rng)
         with self._axes_context():
             if self._jit_train is None:
@@ -140,6 +145,7 @@ class SeqParallelSolver(Solver):
                 self.params, self.state, self.history, dev,
                 self._it_dev, key)
         self.iter += 1
+        self._timing["train_step"] += _time.perf_counter() - t0
         return loss
 
     def _build_eval_step(self):

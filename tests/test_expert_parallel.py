@@ -1,0 +1,125 @@
+"""ExpertParallelSolver (dp x ep): loss-curve equality vs single-device,
+real weight/optimizer-state sharding, routing diagnostics, and the
+MoE-vs-dense-FFN training comparison at matched parameter count."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from sparknet_tpu.proto import Message
+from sparknet_tpu.models import zoo
+from sparknet_tpu.parallel import make_mesh, ExpertParallelSolver
+from sparknet_tpu.solver.solver import Solver
+from sparknet_tpu.data.synthetic import lm_batch_stream
+
+
+def _sp(lr=0.1, seed=0):
+    return Message("SolverParameter", base_lr=lr, lr_policy="fixed",
+                   momentum=0.9, display=0, random_seed=seed)
+
+
+def _moe_net(aux=0.0, cf=4.0, stats=False, experts=4):
+    return zoo.transformer_lm(vocab_size=32, seq_len=16, batch_size=8,
+                              d_model=16, num_layers=1, num_heads=2,
+                              flash=False, moe_experts=experts,
+                              moe_aux_weight=aux, moe_capacity_factor=cf,
+                              moe_stats=stats)
+
+
+def _batches(n, B=8, S=16, V=32, seed=0):
+    rs = np.random.RandomState(seed)
+    out = []
+    for _ in range(n):
+        toks = rs.randint(0, V, (B, S + 1))
+        out.append({"data": toks[:, :-1], "label": toks[:, 1:]})
+    return out
+
+
+def test_ep_solver_matches_single_device():
+    """dp=2 x ep=4: with no-overflow capacity and aux weight 0, the whole
+    loss curve equals the single-device run's (the grad reduction incl.
+    the 1/ep factor for expert-sharded params is exact)."""
+    net = _moe_net(aux=0.0, cf=4.0)
+    ep = ExpertParallelSolver(_sp(), mesh=make_mesh({"data": 2,
+                                                     "expert": 4}),
+                              net_param=net)
+    ref = Solver(_sp(), net_param=net)
+    el, rl = [], []
+    for b in _batches(6):
+        el.append(float(ep.train_step(b)))
+        rl.append(float(ref.train_step(b)))
+    np.testing.assert_allclose(el, rl, rtol=1e-4, atol=1e-5)
+
+
+def test_ep_shards_expert_weights_and_history():
+    """w1/b1/w2/b2 and their momentum slots live sharded over the expert
+    axis (each device holds num_experts/ep experts); router + non-MoE
+    params stay replicated."""
+    ep = ExpertParallelSolver(_sp(), mesh=make_mesh({"data": 1,
+                                                     "expert": 4}),
+                              net_param=_moe_net())
+    moe = ep.params["block0/moe"]
+    X = moe[0].shape[0]
+    for i in (1, 2, 3, 4):          # w1, b1, w2, b2
+        assert moe[i].addressable_shards[0].data.shape[0] == X // 4, i
+        hist = ep.history["block0/moe"][i][0]
+        assert hist.addressable_shards[0].data.shape[0] == X // 4, i
+    # router and a non-MoE layer replicated (full shape on every device)
+    assert moe[0].addressable_shards[0].data.shape == moe[0].shape
+    head = ep.params["lm_head"][0]
+    assert head.addressable_shards[0].data.shape == head.shape
+
+
+def test_ep_rejects_indivisible_experts():
+    import pytest
+    with pytest.raises(ValueError, match="num_experts"):
+        ExpertParallelSolver(_sp(), mesh=make_mesh({"data": 1,
+                                                    "expert": 8}),
+                             net_param=_moe_net(experts=4))
+
+
+def test_ep_stats_top_reports_utilization():
+    """The weight-0 diagnostics top: per-expert token fractions sum to 1,
+    overflow fraction is 0 at no-overflow capacity."""
+    net = _moe_net(aux=0.01, cf=4.0, stats=True)
+    solver = Solver(_sp(), net_param=net)
+    b = _batches(1)[0]
+    _, (blobs, _) = solver.net.loss_fn(
+        solver.params, solver.state,
+        {k: jnp.asarray(v) for k, v in b.items()}, jax.random.PRNGKey(0))
+    stats = np.asarray(blobs["block0/moe_stats"])
+    assert stats.shape == (5,)
+    np.testing.assert_allclose(stats[:4].sum(), 1.0, atol=1e-5)
+    assert stats[4] == 0.0
+
+
+def test_moe_matches_dense_ffn_twin_at_matched_params():
+    """Training evidence at matched TOTAL FFN parameter count: a 4-expert
+    MoE LM (hidden F per expert) vs the dense twin with d_ff = 4F, same
+    data/schedule, on the learnable bigram corpus. Both must make real
+    progress toward the floor and land within tolerance of each other —
+    top-1 routing activates 1/4 of the FFN params per token yet matches
+    the dense model's quality on this task."""
+    V, S, B, D, F = 64, 32, 16, 32, 32
+    stream, floor = lm_batch_stream(V, B, S, seed=3)
+    batches = [next(stream) for _ in range(600)]
+    start = float(np.log(V))
+
+    def train(net, lr=0.5):
+        solver = Solver(_sp(lr=lr, seed=1), net_param=net)
+        for b in batches:
+            loss = solver.train_step(b)
+        return float(loss)
+
+    moe = train(zoo.transformer_lm(
+        vocab_size=V, seq_len=S, batch_size=B, d_model=D, num_layers=1,
+        num_heads=2, flash=False, moe_experts=4, d_ff=F,
+        moe_aux_weight=0.01))
+    dense = train(zoo.transformer_lm(
+        vocab_size=V, seq_len=S, batch_size=B, d_model=D, num_layers=1,
+        num_heads=2, flash=False, d_ff=4 * F))
+    # both cover most of the untrained->floor gap...
+    assert moe < start - 0.6 * (start - floor), (moe, start, floor)
+    assert dense < start - 0.6 * (start - floor), (dense, start, floor)
+    # ...and agree with each other
+    assert abs(moe - dense) < 0.25, (moe, dense, floor)

@@ -112,3 +112,49 @@ def test_remat_off_for_eval_keeps_all_blobs(monkeypatch):
     blobs, _ = net.apply(params, state, _batch(), train=False)
     # eval ignores remat: every internal block blob stays inspectable
     assert any(k.startswith("block0/") for k in blobs)
+
+
+def test_remat_no_stale_pre_segment_blob(monkeypatch):
+    """A blob produced BEFORE a remat segment and overwritten in-place
+    inside it must be absent from the returned dict, not stale: returning
+    the pre-segment value would silently hand callers wrong data."""
+    from sparknet_tpu.models import dsl
+
+    def _renamed_top(lp, top):
+        lp.clear("top")
+        lp.top.append(top)
+        return lp
+
+    net_param = dsl.NetParam(
+        "stale",
+        dsl.RDDLayer("data", [2, 8]),
+        dsl.RDDLayer("label", [2, 8]),
+        dsl.EmbedLayer("emb", ["data"], 16, 8,
+                       weight_filler=dict(type="xavier")),
+        # "x" is produced BEFORE the segment, then blk/ip re-tops it and
+        # blk/relu overwrites it in-place inside the "blk/" remat segment
+        _renamed_top(dsl.InnerProductLayer(
+            "pre", ["emb"], 8, weight_filler=dict(type="xavier"), axis=2),
+            "x"),
+        _renamed_top(dsl.InnerProductLayer(
+            "blk/ip", ["x"], 8, weight_filler=dict(type="xavier"), axis=2),
+            "x"),
+        dsl.ReLULayer("blk/relu", ["x"], tops=["x"]),
+        dsl.InnerProductLayer("blk/head", ["x"], 16,
+                              weight_filler=dict(type="xavier"), axis=2),
+        dsl.SoftmaxWithLoss("loss", ["blk/head", "label"], axis=2),
+    )
+    net = CompiledNet(net_param, TRAIN)
+    assert net._remat_groups(), "blk/ layers should form a segment"
+    params, state = net.init(jax.random.PRNGKey(0))
+    batch = {"data": np.zeros((2, 8), np.int32),
+             "label": np.zeros((2, 8), np.int32)}
+
+    monkeypatch.setenv("SPARKNET_REMAT", "0")
+    blobs_off, _ = net.apply(params, state, batch, train=True)
+    monkeypatch.setenv("SPARKNET_REMAT", "1")
+    blobs_on, _ = net.apply(params, state, batch, train=True)
+    # "x" is overwritten inside the segment and not needed afterwards:
+    # it must be ABSENT, never the stale pre-segment value
+    assert "x" in blobs_off
+    assert "x" not in blobs_on

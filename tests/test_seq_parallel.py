@@ -61,3 +61,30 @@ def test_sp_rejects_indivisible_dims():
                               vocab_size=V, seq_len=S + 4, batch_size=B,
                               d_model=D, num_layers=1, num_heads=2,
                               flash=False, ring=True))
+
+
+def test_sp_rejects_ignore_label_loss():
+    """ignore_label losses normalize by the per-shard valid count, which
+    breaks the equal-shard exactness contract — refuse at init."""
+    np2 = _net()
+    for lp in np2.layer:
+        if lp.name == "loss":
+            lp.loss_param = Message("LossParameter", ignore_label=0)
+    with pytest.raises(ValueError, match="ignore_label"):
+        SeqParallelSolver(_sp(), mesh=make_mesh({"data": 1, "seq": 8}),
+                          net_param=np2)
+
+
+def test_sp_allows_rank1_feed_blobs():
+    """(B,)-shaped feed blobs need no sequence shard: they stay
+    batch-sharded / seq-replicated instead of erroring at init."""
+    from sparknet_tpu.models import dsl
+    from sparknet_tpu.parallel.data_parallel import _rebatch
+    from sparknet_tpu.graph.compiler import CompiledNet
+    np3 = zoo.transformer_lm(vocab_size=V, seq_len=S, batch_size=B,
+                             d_model=D, num_layers=1, num_heads=2,
+                             flash=False, ring=True)
+    np3.layer.insert(2, dsl.RDDLayer("wt", [B]))
+    local = _rebatch(CompiledNet(np3), 2, seq=4)
+    assert local.feed_shapes()["wt"] == (B // 2,)
+    assert local.feed_shapes()["data"] == (B // 2, S // 4)
