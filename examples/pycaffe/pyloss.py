@@ -1,0 +1,29 @@
+"""Euclidean loss as a user-defined Python layer — the sparknet_tpu twin
+of reference examples/pycaffe/layers/pyloss.py, consumed unchanged by the
+stock examples/pycaffe/linreg.prototxt (python_param {module: 'pyloss'
+layer: 'EuclideanLossLayer'}).
+
+Where the reference class mutates blob .data/.diff buffers and hand-writes
+backward(), here forward is one pure jnp expression and the gradient is
+jax autodiff — nothing else to write (ops/python_layer.py docstring)."""
+
+import jax.numpy as jnp
+
+
+class EuclideanLossLayer:
+    """sum((x - y)^2) / num / 2 — identical math to the C++
+    EuclideanLossLayer (and the reference pyloss.py)."""
+
+    def setup(self, bottom_shapes):
+        if len(bottom_shapes) != 2:
+            raise ValueError("Need two inputs to compute distance.")
+
+    def reshape(self, bottom_shapes):
+        import numpy as np
+        if np.prod(bottom_shapes[0]) != np.prod(bottom_shapes[1]):
+            raise ValueError("Inputs must have the same dimension.")
+        return (1,)
+
+    def forward(self, params, bottoms):
+        diff = (bottoms[0] - bottoms[1]).astype(jnp.float32)
+        return jnp.sum(diff * diff).reshape(1) / bottoms[0].shape[0] / 2.0

@@ -21,3 +21,8 @@ Layer map (vs reference SURVEY.md section 1):
 """
 
 __version__ = "0.1.0"
+
+# public custom-layer API (see ops/python_layer.py): subclass Layer,
+# decorate with @register_layer, and prototxts can use your type string —
+# or use type: "Python" + python_param to plug a class in by module path.
+from .graph.registry import Layer, register as register_layer  # noqa: E402,F401

@@ -257,7 +257,14 @@ def cmd_train(args):
             train_src.close()
         if test_src is not None:
             test_src.close()
-    if prefix and sp.snapshot:
+    # final snapshot unless disabled or this iter was already snapshotted
+    # by the in-loop cadence (reference solver.cpp Solve tail :300-306,
+    # snapshot_after_train). The cadence path only fires when the
+    # SolverParameter itself carries a prefix (Solver.step), so a
+    # --snapshot-prefix-only run must still get its tail snapshot.
+    cadence_fired = int(sp.snapshot) and sp.has("snapshot_prefix") \
+        and solver.iter % int(sp.snapshot) == 0
+    if prefix and sp.snapshot_after_train and not cadence_fired:
         solver.snapshot(prefix=prefix)
     print(f"Optimization done, iter={solver.iter}")
     return 0
@@ -311,7 +318,8 @@ def cmd_convert_cifar(args):
 def cmd_make_synth_cifar(args):
     from . import tools
     tools.make_synth_cifar(args.output, n_train=args.train, n_test=args.test,
-                           seed=args.seed, noise=args.noise)
+                           seed=args.seed, noise=args.noise,
+                           label_noise=args.label_noise)
     return 0
 
 
@@ -509,7 +517,12 @@ def cmd_lm(args):
                       f"[{', '.join(f'{u:.3f}' for u in util)}] "
                       f"overflow {overflow:.4f}")
                 if metrics:
+                    # eval_ce = the SoftmaxWithLoss top alone — the
+                    # train "loss" series includes the weighted aux terms
+                    ce = scores.get("loss")
                     metrics.log("moe", iter=solver.iter,
+                                eval_ce=round(float(np.mean(ce)), 4)
+                                if ce is not None else None,
                                 expert_util=[round(float(u), 4)
                                              for u in util],
                                 overflow_fraction=round(overflow, 5),
@@ -653,6 +666,9 @@ def main(argv=None):
     ms.add_argument("--test", type=int, default=10000)
     ms.add_argument("--seed", type=int, default=0)
     ms.add_argument("--noise", type=float, default=28.0)
+    ms.add_argument("--label-noise", type=float, default=0.0,
+                    help="fraction of labels resampled uniformly (hard "
+                         "mode: caps accuracy at (1-p)+p/10)")
     ms.set_defaults(fn=cmd_make_synth_cifar)
 
     cm = sub.add_parser("compute_image_mean",

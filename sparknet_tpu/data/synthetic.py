@@ -33,12 +33,19 @@ def class_gaussian_images(n, shape=(3, 32, 32), num_classes=10, seed=0,
 
 
 def shape_texture_images(n, seed=0, size=32, noise=28.0, num_classes=10,
-                         chunk=2048):
+                         chunk=2048, label_noise=0.0):
     """(images uint8 (n, 3, size, size) CHW, labels int32 (n,)).
 
     Ten shape/texture classes under random rotation (±26°), scale,
     translation, colors and noise.  Orientation stays informative (stripe
     classes 4/5 differ by it), so rotation is bounded rather than uniform.
+
+    ``label_noise`` > 0 is the HARD mode for convergence experiments:
+    that fraction of RETURNED labels is resampled uniformly after
+    rendering (images keep their true class), capping attainable test
+    accuracy at (1-p) + p/K — e.g. 0.73 at p=0.3, K=10 — so strategy
+    comparisons run in a contested 60-75% plateau region instead of the
+    ~95% band where everything looks the same.
     """
     if num_classes > 10:
         raise ValueError("only 10 shape classes are defined")
@@ -108,6 +115,11 @@ def shape_texture_images(n, seed=0, size=32, noise=28.0, num_classes=10,
         pix = bg[:, :, None, None] + (fg - bg)[:, :, None, None] * m[:, None]
         pix += rs.randn(b, 3, size, size).astype(np.float32) * noise
         imgs[i0:i1] = np.clip(pix, 0, 255).astype(np.uint8)
+    if label_noise > 0:
+        labels = labels.copy()
+        flip = rs.rand(n) < label_noise
+        labels[flip] = rs.randint(0, num_classes,
+                                  int(flip.sum())).astype(np.int32)
     return imgs, labels
 
 

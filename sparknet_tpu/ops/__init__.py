@@ -17,4 +17,5 @@ from . import (  # noqa: F401
     feed,
     attention,
     moe,
+    python_layer,
 )

@@ -1,0 +1,136 @@
+"""User-defined layers: the ``type: "Python"`` escape hatch.
+
+Rebuilds the reference's custom-layer mechanism (layer_factory.cpp:202
+GetPythonLayer + include/caffe/python_layer.hpp): a prototxt layer
+
+    layer {
+      type: 'Python'
+      python_param { module: 'mylayers'  layer: 'MyLayer'
+                     param_str: '{"k": 3}' }
+    }
+
+imports ``module`` (which must be importable: on PYTHONPATH / sys.path,
+or on the colon-separated SPARKNET_PYTHON_LAYER_PATH), instantiates class
+``layer`` and drives it through the net build — without touching the
+framework. As in the reference, a Python layer is NOT automatically a
+loss layer; give it an explicit ``loss_weight`` (python_layer.hpp has no
+type()-based loss detection either — see linreg.prototxt's comment).
+
+The user class is TPU-first, so the interface is PURE — jnp in, jnp out,
+traced under jit — which collapses the reference's four imperative
+blob-mutation hooks into shape inference + one forward:
+
+    class MyLayer:
+        def setup(self, bottom_shapes):           # optional; param_str,
+            ...                                   # phase, name already set
+        def reshape(self, bottom_shapes):         # required
+            return [top_shape, ...]               # (a tuple = ONE shape)
+        def forward(self, params, bottoms):       # required; pure jnp.
+            return [tops]                         # (or one array)
+        def param_shapes(self):                   # optional learnable
+            return [(shape, filler_msg_or_None, lr_mult, decay_mult)]
+
+``backward`` does not exist: gradients come from jax autodiff of
+``forward`` (the reference made users hand-write Backward_cpu against
+mutable diff blobs). ``forward`` may take a third ``train`` argument to
+distinguish phases. Registering a layer under its OWN type string —
+the richer alternative to type:"Python" — is public API too:
+
+    from sparknet_tpu import Layer, register_layer
+    @register_layer
+    class MyOp(Layer):
+        type_name = "MyOp"
+        ...
+"""
+
+import importlib
+import inspect
+import os
+import sys
+
+from ..graph.registry import Layer, register
+
+
+def _load_user_class(module_name, class_name):
+    extra = [p for p in
+             os.environ.get("SPARKNET_PYTHON_LAYER_PATH", "").split(":")
+             if p]
+    added = [p for p in extra if p not in sys.path]
+    sys.path[:0] = added
+    try:
+        try:
+            mod = importlib.import_module(module_name)
+        except ImportError as e:
+            raise ImportError(
+                f"python_param.module {module_name!r} not importable "
+                f"({e}); put it on PYTHONPATH or "
+                f"SPARKNET_PYTHON_LAYER_PATH") from e
+    finally:
+        for p in added:
+            sys.path.remove(p)
+    try:
+        return getattr(mod, class_name)
+    except AttributeError:
+        raise AttributeError(
+            f"module {module_name!r} has no class "
+            f"{class_name!r} (python_param.layer)") from None
+
+
+@register
+class PythonLayer(Layer):
+    type_name = "Python"
+
+    def __init__(self, lp, bottom_shapes, phase):
+        super().__init__(lp, bottom_shapes, phase)
+        pp = lp.python_param
+        if not pp.module or not pp.layer:
+            raise ValueError(
+                f"{lp.name}: python_param needs module and layer")
+        cls = _load_user_class(pp.module, pp.layer)
+        obj = cls()
+        # reference python_layer.hpp LayerSetUp: param_str is set on the
+        # instance before setup() runs; phase/name are handy extras
+        obj.param_str = pp.param_str
+        obj.phase = phase
+        obj.name = lp.name
+        if hasattr(obj, "setup"):
+            obj.setup(self.bottom_shapes)
+        if not hasattr(obj, "reshape") or not hasattr(obj, "forward"):
+            raise TypeError(
+                f"{lp.name}: {pp.module}.{pp.layer} must define "
+                "reshape(bottom_shapes) and forward(params, bottoms)")
+        tops = obj.reshape(self.bottom_shapes)
+        if isinstance(tops, tuple):                # one bare shape tuple
+            tops = [tops]
+        self._top_shapes = [tuple(s) for s in tops]
+        want, got = len(lp.top), len(self._top_shapes)
+        if want != got:
+            raise ValueError(
+                f"{lp.name}: reshape() returned {got} top shape(s) for "
+                f"{want} declared top(s)")
+        self._obj = obj
+        fwd_params = inspect.signature(obj.forward).parameters
+        self._fwd_takes_train = len(fwd_params) >= 3
+
+    def param_shapes(self):
+        if not hasattr(self._obj, "param_shapes"):
+            return []
+        from ..proto import Message
+        out = []
+        for shape, filler, lr, decay in self._obj.param_shapes():
+            if isinstance(filler, dict):       # plain-dict convenience
+                filler = Message("FillerParameter", **filler)
+            out.append((tuple(shape), filler, lr, decay))
+        return out
+
+    def out_shapes(self):
+        return self._top_shapes
+
+    def apply(self, params, bottoms, train, rng):
+        if self._fwd_takes_train:
+            out = self._obj.forward(params, bottoms, train)
+        else:
+            out = self._obj.forward(params, bottoms)
+        if not isinstance(out, (list, tuple)):
+            out = [out]
+        return list(out)

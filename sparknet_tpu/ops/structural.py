@@ -321,15 +321,64 @@ class BatchReindex(Layer):
 
 @register
 class Filter(Layer):
-    """Selects batch items whose selector is nonzero (filter_layer.cpp).
-    The output batch size is data-dependent — incompatible with XLA static
-    shapes, so this layer is host-only by design: it cannot appear inside the
-    compiled train step. Kept for inventory parity; use BatchReindex with
-    host-computed indices instead."""
+    """Selects batch items whose selector is nonzero (filter_layer.cpp),
+    with CAPACITY-PADDED semantics — the documented deviation from Caffe.
+
+    bottom[0..k-1] are the blobs to filter; bottom[k] is the selector:
+    shape (N,) or (N, 1, ...) (singleton trailing dims, Reshape's CHECK).
+    Caffe shrinks top batch to the selected count — a data-dependent
+    shape, which XLA's static-shape compilation model cannot express.
+    Here each top keeps the FULL input batch N: selected items are
+    compacted to the front in stable order (matching Caffe's
+    indices_to_forward_ order) and the tail rows are zero. One OPTIONAL
+    extra top (declare k+1 tops) receives the valid count as a scalar so
+    downstream consumers can mask: the standard XLA capacity-padding
+    discipline (the same trick ops/moe.py uses for expert overflow).
+
+    Backward is jax autodiff of the gather: cotangents scatter home to
+    selected rows, zeros elsewhere — exactly filter_layer.cpp's
+    Backward_cpu, with no hand-written index bookkeeping."""
 
     type_name = "Filter"
 
     def __init__(self, lp, bottom_shapes, phase):
-        raise NotImplementedError(
-            "Filter has data-dependent output shapes, which XLA cannot "
-            "compile; precompute indices on host and use BatchReindex.")
+        super().__init__(lp, bottom_shapes, phase)
+        sel = bottom_shapes[-1]
+        if any(d != 1 for d in sel[1:]):
+            raise ValueError(
+                f"{lp.name}: selector dims past the first must be "
+                f"singletons, got {tuple(sel)}")
+        n = sel[0]
+        for i, s in enumerate(bottom_shapes[:-1]):
+            if s[0] != n:
+                raise ValueError(
+                    f"{lp.name}: bottom {i} batch {s[0]} != selector "
+                    f"batch {n}")
+        ndata = len(bottom_shapes) - 1
+        if len(lp.top) not in (ndata, ndata + 1):
+            raise ValueError(
+                f"{lp.name}: Filter needs {ndata} tops (or {ndata + 1} "
+                f"with the valid-count top), got {len(lp.top)}")
+        self._with_count = len(lp.top) == ndata + 1
+
+    def out_shapes(self):
+        shapes = [tuple(s) for s in self.bottom_shapes[:-1]]
+        if self._with_count:
+            shapes.append(())
+        return shapes
+
+    def apply(self, params, bottoms, train, rng):
+        sel = bottoms[-1].reshape(bottoms[-1].shape[0])
+        keep = sel != 0
+        n = keep.shape[0]
+        # stable compaction: kept indices first, original order preserved
+        order = jnp.argsort(jnp.logical_not(keep), stable=True)
+        kmask = keep[order]                       # first count rows True
+        tops = []
+        for x in bottoms[:-1]:
+            y = jnp.take(x, order, axis=0)
+            y = y * kmask.reshape((n,) + (1,) * (y.ndim - 1)).astype(y.dtype)
+            tops.append(y)
+        if self._with_count:
+            tops.append(jnp.sum(keep.astype(jnp.int32)))
+        return tops

@@ -71,6 +71,32 @@ def shard_batch(batch, mesh, axis=DATA_AXIS, batch_dim=0, seq_axis=None,
     return out
 
 
+def place_tree(tree, specs, mesh):
+    """Place every leaf of ``tree`` on ``mesh`` per the matching
+    PartitionSpec in ``specs`` (a pytree of specs with the same
+    structure, or prefixes of it). Single-process: device_put.
+    Multi-process: every host holds the full value (seed-identical
+    init — the global-feed discipline), so the global array assembles
+    via make_array_from_callback."""
+    multihost = jax.process_count() > 1
+
+    def put(spec, sub):
+        sh = NamedSharding(mesh, spec)
+
+        def one(x):
+            if multihost:
+                arr = np.asarray(x)
+                return jax.make_array_from_callback(
+                    arr.shape, sh, lambda idx, a=arr: a[idx])
+            return jax.device_put(x, sh)
+        # sub may be a SUBTREE (specs as a prefix tree: e.g. one spec per
+        # param covering all its history slots)
+        return jax.tree_util.tree_map(one, sub)
+
+    return jax.tree_util.tree_map(put, specs, tree,
+                                  is_leaf=lambda s: isinstance(s, P))
+
+
 def check_global_feed(batch):
     """First-step agreement check for the global-feed discipline (every
     host passes the SAME full batch; devices pull their own blocks): a

@@ -37,11 +37,11 @@ same Solver API as the other axes.
 import numpy as np
 import jax
 import jax.numpy as jnp
-from jax.sharding import NamedSharding, PartitionSpec as P
+from jax.sharding import PartitionSpec as P
 
 from ..solver.solver import Solver
 from .data_parallel import _rebatch, _batch_specs, shard_batch, \
-    check_global_feed
+    check_global_feed, place_tree
 from . import context
 
 
@@ -109,17 +109,7 @@ class ExpertParallelSolver(Solver):
         return specs, flags
 
     def _place(self, tree, specs):
-        multihost = jax.process_count() > 1
-
-        def put(x, spec):
-            sh = NamedSharding(self.mesh, spec)
-            if multihost:
-                arr = np.asarray(x)
-                return jax.make_array_from_callback(
-                    arr.shape, sh, lambda idx, a=arr: a[idx])
-            return jax.device_put(x, sh)
-
-        return jax.tree_util.tree_map(put, tree, specs)
+        return place_tree(tree, specs, self.mesh)
 
     def _axes_context(self):
         return context.axis_context(data=self.data_axis,

@@ -41,12 +41,19 @@ class GSPMDSolver(Solver):
     fn(layer_name, blob_idx, shape) -> PartitionSpec for that weight blob.
     """
 
-    def __init__(self, solver_param, mesh=None, param_rule=None, **kw):
+    def __init__(self, solver_param, mesh=None, param_rule=None,
+                 seq_axis=None, **kw):
         from .mesh import make_mesh
         self.mesh = mesh if mesh is not None else \
             make_mesh({DATA_AXIS: -1, MODEL_AXIS: 1})
         msize = self.mesh.shape.get(MODEL_AXIS, 1)
         self.param_rule = param_rule or default_param_rule(msize)
+        # optional third axis: shard dim 1 (sequence) of rank>=2 feed
+        # blobs — the annotation-style sp that composes dp x tp x sp on
+        # one mesh. XLA's SPMD partitioner places the attention/loss
+        # collectives itself (no ring schedule; use SeqParallelSolver
+        # when you want O(S/sp) attention memory via ppermute).
+        self.seq_axis = seq_axis
         super().__init__(solver_param, **kw)
         self._shard_state()
 
@@ -77,8 +84,13 @@ class GSPMDSolver(Solver):
         out = {}
         for k, v in batch.items():
             nd = np.ndim(v)
-            out[k] = NamedSharding(self.mesh,
-                                   P(DATA_AXIS) if nd else P())
+            if not nd:
+                spec = P()
+            elif self.seq_axis is not None and nd >= 2:
+                spec = P(DATA_AXIS, self.seq_axis)
+            else:
+                spec = P(DATA_AXIS)
+            out[k] = NamedSharding(self.mesh, spec)
         return out
 
     # -- compiled step -----------------------------------------------------

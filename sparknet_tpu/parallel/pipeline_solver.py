@@ -34,6 +34,7 @@ from ..graph.compiler import CompiledNet, TRAIN
 from ..solver.lr_policy import make_lr_fn
 from ..solver.updates import Updater
 from .pipeline import pipeline_apply, stack_params
+from .data_parallel import check_global_feed, place_tree
 from .mesh import make_mesh
 
 
@@ -61,6 +62,14 @@ class PipelineLMSolver:
         from ..models import zoo
         self.param = solver_param
         self.log = log_fn or (lambda *a: None)
+        if jax.process_count() > 1 and int(solver_param.random_seed) < 0:
+            # the pipe axis spans hosts: every host must hold the SAME
+            # stacked params and batch (global-feed discipline, like
+            # Seq/ExpertParallelSolver)
+            raise ValueError(
+                "multi-process PipelineLMSolver requires an explicit "
+                "SolverParameter.random_seed: hosts must agree on param "
+                "init and rng streams")
         if isinstance(metrics, str):
             from ..utils.metrics import MetricsLogger
             metrics = MetricsLogger(metrics)
@@ -108,6 +117,17 @@ class PipelineLMSolver:
         mults = {ln: [(1.0, 1.0)] * len(v) for ln, v in self.params.items()}
         self.updater = Updater(solver_param, mults)
         self.history = self.updater.init(self.params)
+        # place params/history on the mesh up front (stage-sharded blocks,
+        # replicated ends); required for multi-process, where jit cannot
+        # shard host-local arrays across hosts itself
+        pspec = {ln: [P(self.axis) if ln.startswith("blocks/") else P()
+                      for _ in blobs]
+                 for ln, blobs in self.params.items()}
+        hspec = {ln: [[pspec[ln][i]] * len(slot)
+                      for i, slot in enumerate(self.history[ln])]
+                 for ln in self.history}
+        self.params = place_tree(self.params, pspec, self.mesh)
+        self.history = place_tree(self.history, hspec, self.mesh)
         self.lr_fn = make_lr_fn(solver_param)
         self.iter = 0
         self._it_dev = None
@@ -171,12 +191,15 @@ class PipelineLMSolver:
     def train_step(self, batch):
         if self._jit_train is None:
             self._jit_train = self._build_train_step()
+        if jax.process_count() > 1 and not getattr(self, "_feed_checked",
+                                                   False):
+            self._feed_checked = True
+            check_global_feed(batch)
         self.rng, key = jax.random.split(self.rng)
         if self._it_dev is None:
             self._it_dev = jnp.asarray(self.iter, jnp.int32)
-        rep = NamedSharding(self.mesh, P())
-        batch = {k: jax.device_put(np.asarray(v), rep)
-                 for k, v in batch.items()}
+        batch = place_tree({k: np.asarray(v) for k, v in batch.items()},
+                           {k: P() for k in batch}, self.mesh)
         self.params, self.history, loss, self._it_dev = self._jit_train(
             self.params, self.history, batch, self._it_dev, key)
         self.iter += 1

@@ -81,15 +81,29 @@ class Solver:
         self.metrics = metrics
         self.watchdog = None
         train_np, test_np = resolve_nets(solver_param, base_dir, net_param)
+        # NetState from the solver (reference solver.cpp InitTrainNet /
+        # InitTestNets: train_state / test_state merge into the filter
+        # state — e.g. mnist_autoencoder_solver's per-test-net
+        # 'test-on-train'/'test-on-test' stages select among same-named
+        # Data layers). Like the single test_net, only test_state[0] is
+        # instantiated here.
+        ts = solver_param.train_state \
+            if solver_param.has("train_state") else None
         self.net = CompiledNet(train_np, TRAIN, feed_shapes=feed_shapes,
-                               dtype=dtype, compute_dtype=compute_dtype)
+                               dtype=dtype, compute_dtype=compute_dtype,
+                               level=int(ts.level) if ts else 0,
+                               stages=tuple(ts.stage) if ts else ())
         self.test_net = None
         if test_np is not None:
+            es = solver_param.test_state[0] \
+                if solver_param.test_state else None
             try:
                 self.test_net = CompiledNet(
                     test_np, TEST,
                     feed_shapes=test_feed_shapes or feed_shapes, dtype=dtype,
-                    compute_dtype=compute_dtype)
+                    compute_dtype=compute_dtype,
+                    level=int(es.level) if es else 0,
+                    stages=tuple(es.stage) if es else ())
             except ValueError:
                 # a shared `net` whose data layer is TRAIN-only has no
                 # TEST-phase graph; without a test_iter schedule the

@@ -85,7 +85,7 @@ def compute_image_mean(db_path, out_path=None, backend="lmdb", log=print):
 
 
 def make_synth_cifar(out_dir, n_train=50000, n_test=10000, seed=0,
-                     noise=28.0, log=print):
+                     noise=28.0, label_noise=0.0, log=print):
     """Write a CIFAR-10-format synthetic dataset (5 train .bin batches +
     test_batch.bin) of shape/texture-class images (see
     data/synthetic.shape_texture_images).  Stands in for the real bits the
@@ -97,11 +97,13 @@ def make_synth_cifar(out_dir, n_train=50000, n_test=10000, seed=0,
     os.makedirs(out_dir, exist_ok=True)
     per = n_train // 5
     for b in range(5):
-        imgs, labels = shape_texture_images(per, seed=seed + b, noise=noise)
+        imgs, labels = shape_texture_images(per, seed=seed + b, noise=noise,
+                                            label_noise=label_noise)
         write_batch_file(os.path.join(out_dir, f"data_batch_{b + 1}.bin"),
                          imgs, labels)
         log(f"data_batch_{b + 1}.bin: {per} records")
-    imgs, labels = shape_texture_images(n_test, seed=seed + 1000, noise=noise)
+    imgs, labels = shape_texture_images(n_test, seed=seed + 1000, noise=noise,
+                                        label_noise=label_noise)
     write_batch_file(os.path.join(out_dir, "test_batch.bin"), imgs, labels)
     log(f"test_batch.bin: {n_test} records")
 

@@ -256,3 +256,32 @@ class TestAppIntegration:
         app3 = CifarApp(num_workers=4, strategy="local_sgd", tau=7, seed=0)
         import numpy as np
         assert np.array_equal(batch["label"], app3._tau_batches(7)["label"])
+
+
+# stock mnist solver family: solver-type x lr-policy parity proven against
+# stock FILES (Adam / RMSProp / SGD+multistep / AdaDelta / AdaGrad /
+# Nesterov), not just the analytic unit tests in test_solver.py
+_MNIST = reference_path("caffe", "examples", "mnist")
+_LENET_SHAPES = ["--input-shape", "data=64,1,28,28",
+                 "--input-shape", "label=64"]
+_AE_SHAPES = ["--input-shape", "data=100,1,28,28"]
+_STOCK_SOLVERS = [
+    ("lenet_solver_adam.prototxt", _LENET_SHAPES),
+    ("lenet_solver_rmsprop.prototxt", _LENET_SHAPES),
+    ("lenet_multistep_solver.prototxt", _LENET_SHAPES),
+    ("lenet_adadelta_solver.prototxt", _LENET_SHAPES),
+    ("mnist_autoencoder_solver_adagrad.prototxt", _AE_SHAPES),
+    ("mnist_autoencoder_solver_nesterov.prototxt", _AE_SHAPES),
+]
+
+
+@pytest.mark.parametrize("fname,shapes", _STOCK_SOLVERS,
+                         ids=[f for f, _ in _STOCK_SOLVERS])
+def test_stock_solver_prototxt_trains(fname, shapes, tmp_path, capsys):
+    path = os.path.join(_MNIST, fname)
+    if not os.path.exists(path):
+        pytest.skip("reference prototxts unavailable")
+    assert cli.main(["train", "--solver", path, *shapes,
+                     "--snapshot-prefix", str(tmp_path / "snap"),
+                     "--iterations", "3"]) == 0
+    assert "Optimization done, iter=3" in capsys.readouterr().out

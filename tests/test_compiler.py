@@ -282,6 +282,17 @@ _STOCK_NETS = [
     # feeds stand in, like the other data layers)
     ("examples/finetune_pascal_detection/pascal_finetune_trainval_test"
      ".prototxt", {"data": (2, 3, 227, 227), "label": (2,)}),
+    # sliced multi-loss autoencoder (label-free Data layer, Sigmoid
+    # stack, SigmoidCrossEntropy + Euclidean losses off one Slice)
+    ("examples/mnist/mnist_autoencoder.prototxt",
+     {"data": (2, 1, 28, 28)}),
+    # net-surgery pair: the 1x1-conv toy and the fully-convolutional
+    # CaffeNet rewrite (deploy nets: `input` decls)
+    ("examples/net_surgery/conv.prototxt", None),
+    ("examples/net_surgery/bvlc_caffenet_full_conv.prototxt", None),
+    # feature-extraction net (ImageData source -> feeds stand in)
+    ("examples/feature_extraction/imagenet_val.prototxt",
+     {"data": (2, 3, 227, 227), "label": (2,)}),
 ]
 
 _INT_FEEDS = ("label", "sim")

@@ -633,3 +633,86 @@ class TestAttention:
 
 
 WEIGHTS_A = np.linspace(-1.0, 1.0, 4096).astype(np.float32)
+
+
+# -- Filter (capacity-padded semantics; see ops/structural.py) -------------
+
+def _filter_layer(bottom_shapes, ntops, name="filt"):
+    lp = Message("LayerParameter", name=name, type="Filter")
+    lp.bottom.extend([f"b{i}" for i in range(len(bottom_shapes))])
+    lp.top.extend([f"t{i}" for i in range(ntops)])
+    return get_layer("Filter")(lp, bottom_shapes, 0)
+
+
+def test_filter_compacts_selected_rows_and_zero_pads():
+    layer = _filter_layer([(5, 3), (5,), (5, 1)], 2)
+    rs = np.random.RandomState(0)
+    x = jnp.asarray(rs.randn(5, 3), jnp.float32)
+    z = jnp.asarray(rs.randn(5), jnp.float32)
+    sel = jnp.asarray([1.0, 0.0, 1.0, 0.0, 1.0]).reshape(5, 1)
+    tx, tz = layer.apply([], [x, z, sel], True, None)
+    # selected rows 0,2,4 compacted to the front in order; tail zeros
+    np.testing.assert_allclose(np.asarray(tx[:3]),
+                               np.asarray(x)[[0, 2, 4]])
+    np.testing.assert_allclose(np.asarray(tx[3:]), 0.0)
+    np.testing.assert_allclose(np.asarray(tz[:3]),
+                               np.asarray(z)[[0, 2, 4]])
+    np.testing.assert_allclose(np.asarray(tz[3:]), 0.0)
+    # full-batch (padded) static shapes
+    assert tx.shape == (5, 3) and tz.shape == (5,)
+
+
+def test_filter_valid_count_top():
+    layer = _filter_layer([(4, 2), (4,)], 2)   # data top + count top
+    assert layer.out_shapes() == [(4, 2), ()]
+    sel = jnp.asarray([0.0, 1.0, 1.0, 0.0])
+    _, cnt = layer.apply([], [jnp.zeros((4, 2)), sel], True, None)
+    assert int(cnt) == 2
+
+
+def test_filter_gradients_scatter_to_selected_rows():
+    """Autodiff through the compaction == filter_layer.cpp Backward_cpu:
+    cotangents land on selected rows, zero elsewhere."""
+    layer = _filter_layer([(4, 3), (4,)], 2)
+    rs = np.random.RandomState(1)
+    x = jnp.asarray(rs.randn(4, 3), jnp.float32)
+    sel = jnp.asarray([1.0, 0.0, 0.0, 1.0])
+    w = jnp.asarray(rs.randn(4, 3), jnp.float32)
+
+    def f(x):
+        y, _ = layer.apply([], [x, sel], True, None)
+        return jnp.sum(y * w)
+
+    g = np.asarray(jax.grad(f)(x))
+    want = np.zeros((4, 3), np.float32)
+    want[0] = np.asarray(w)[0]        # row 0 -> slot 0
+    want[3] = np.asarray(w)[1]        # row 3 -> slot 1
+    np.testing.assert_allclose(g, want, atol=1e-6)
+
+
+def test_filter_shape_validation():
+    with pytest.raises(ValueError, match="singletons"):
+        _filter_layer([(4, 3), (4, 2)], 1)
+    with pytest.raises(ValueError, match="batch"):
+        _filter_layer([(3, 3), (4,)], 1)
+    with pytest.raises(ValueError, match="tops"):
+        _filter_layer([(4, 3), (4,)], 3 + 1)
+
+
+def test_filter_compiles_in_a_net():
+    """Filter inside a CompiledNet: static shapes end to end."""
+    from sparknet_tpu.models import dsl
+    from sparknet_tpu.graph.compiler import CompiledNet, TRAIN
+    lp = Message("LayerParameter", name="filt", type="Filter")
+    lp.bottom.extend(["x", "sel"])
+    lp.top.extend(["xf", "nvalid"])
+    npm = dsl.NetParam("t", dsl.RDDLayer("x", [4, 3]),
+                       dsl.RDDLayer("sel", [4]), lp)
+    net = CompiledNet(npm, TRAIN)
+    params, state = net.init(jax.random.PRNGKey(0))
+    blobs, _ = net.apply(params, state,
+                         {"x": np.ones((4, 3), np.float32),
+                          "sel": np.asarray([1, 0, 1, 0], np.float32)},
+                         train=True)
+    assert blobs["xf"].shape == (4, 3)
+    assert int(blobs["nvalid"]) == 2

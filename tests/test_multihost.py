@@ -516,3 +516,145 @@ def test_two_process_dp_matches_single_process(tmp_path):
         label = rs.randint(0, 10, 16)
         ref.append(float(solver.train_step({"data": data, "label": label})))
     np.testing.assert_allclose(per_proc[0], ref, rtol=1e-4, atol=1e-5)
+
+
+# one config shared VERBATIM by the 2-process EP workers and the
+# single-process reference (mirrors the _SP_CFG pattern)
+_EP_CFG = dict(B=8, S=16, V=32, D=16, lr=0.1, steps=3, experts=4)
+
+_WORKER_EP = r"""
+import os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+import jax
+jax.config.update("jax_platforms", "cpu")
+pid = int(sys.argv[1]); port = sys.argv[2]
+jax.distributed.initialize(f"localhost:{port}", num_processes=2,
+                           process_id=pid)
+import numpy as np
+sys.path.insert(0, %(repo)r)
+sys.path.insert(0, os.path.join(%(repo)r, "tests"))
+from test_multihost import _ep_solver_and_batches
+
+solver, batches = _ep_solver_and_batches()
+losses = []
+for b in batches:
+    # EVERY host feeds the full global batch (the expert-parallel feeding
+    # discipline); devices pull their own (data, expert) blocks and the
+    # MoE all_to_all crosses the host boundary
+    losses.append(float(solver.train_step(b)))
+print("EP_LOSSES", pid, " ".join(f"{v:.6f}" for v in losses), flush=True)
+# expert weights stay sharded: each host addresses only its 4 devices'
+# experts (1 expert per device at X=4, ep=4)
+w1 = solver.params["block0/moe"][1]
+local = sorted(s.data.shape[0] for s in w1.addressable_shards)
+print("EP_SHARDS", pid, ",".join(map(str, local)), flush=True)
+"""
+
+
+def _ep_solver_and_batches():
+    """The ONE dp x ep config both the multihost workers and the
+    single-process reference train (imported by _WORKER_EP too)."""
+    import numpy as np
+    from sparknet_tpu.proto import Message
+    from sparknet_tpu.models import zoo
+    from sparknet_tpu.parallel import make_mesh, ExpertParallelSolver
+    c = _EP_CFG
+    sp = Message("SolverParameter", base_lr=c["lr"], lr_policy="fixed",
+                 momentum=0.9, display=0, random_seed=0)
+    solver = ExpertParallelSolver(
+        sp, mesh=make_mesh({"data": 2, "expert": 4}),
+        net_param=zoo.transformer_lm(
+            vocab_size=c["V"], seq_len=c["S"], batch_size=c["B"],
+            d_model=c["D"], num_layers=1, num_heads=2, flash=False,
+            moe_experts=c["experts"], moe_aux_weight=0.0,
+            moe_capacity_factor=float(c["experts"])))
+    rs = np.random.RandomState(0)
+    batches = []
+    for _ in range(c["steps"]):
+        toks = rs.randint(0, c["V"], (c["B"], c["S"] + 1))
+        batches.append({"data": toks[:, :-1], "label": toks[:, 1:]})
+    return solver, batches
+
+
+def test_two_process_expert_parallel_matches_single_process(tmp_path):
+    """An "expert" mesh axis spanning 2 real processes: the MoE dispatch
+    all_to_all crosses host boundaries, expert weights stay sharded
+    per-host, and both hosts see the identical loss curve — which also
+    matches the single-process run."""
+    outs = _run_workers(_WORKER_EP, tmp_path, n=2)
+    per = _collect(outs, "EP_LOSSES")
+    np.testing.assert_allclose([float(v) for v in per[0]],
+                               [float(v) for v in per[1]], rtol=1e-5)
+    shards = _collect(outs, "EP_SHARDS")
+    for pid in (0, 1):
+        assert shards[pid][0] == "1,1,1,1", shards[pid]
+
+    solver, batches = _ep_solver_and_batches()   # same config, 1 process
+    ref = [float(solver.train_step(b)) for b in batches]
+    np.testing.assert_allclose([float(v) for v in per[0]], ref,
+                               rtol=1e-3, atol=1e-4)
+
+
+# one config shared by the 2-process PP workers and the single-process
+# reference
+_PP_CFG = dict(B=8, S=16, V=32, D=32, lr=0.05, steps=3, layers=8, micro=4)
+
+_WORKER_PP = r"""
+import os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+import jax
+jax.config.update("jax_platforms", "cpu")
+pid = int(sys.argv[1]); port = sys.argv[2]
+jax.distributed.initialize(f"localhost:{port}", num_processes=2,
+                           process_id=pid)
+import numpy as np
+sys.path.insert(0, %(repo)r)
+sys.path.insert(0, os.path.join(%(repo)r, "tests"))
+from test_multihost import _pp_solver_and_batches
+
+solver, batches = _pp_solver_and_batches()
+losses = []
+for b in batches:
+    # every host feeds the identical full batch; the GPipe ppermute
+    # between stages crosses the host boundary (stages 0-3 on host 0,
+    # 4-7 on host 1)
+    losses.append(float(solver.train_step(b)))
+print("PP_LOSSES", pid, " ".join(f"{v:.6f}" for v in losses), flush=True)
+"""
+
+
+def _pp_solver_and_batches():
+    import numpy as np
+    from sparknet_tpu.proto import Message
+    from sparknet_tpu.parallel import make_mesh, PipelineLMSolver
+    c = _PP_CFG
+    sp = Message("SolverParameter", base_lr=c["lr"], lr_policy="fixed",
+                 momentum=0.9, display=0, random_seed=0)
+    solver = PipelineLMSolver(
+        sp, mesh=make_mesh({"pipe": 8}), num_layers=c["layers"],
+        num_microbatches=c["micro"], vocab_size=c["V"], seq_len=c["S"],
+        batch_size=c["B"], d_model=c["D"], num_heads=4, flash=False)
+    rs = np.random.RandomState(0)
+    batches = []
+    for _ in range(c["steps"]):
+        toks = rs.randint(0, c["V"], (c["B"], c["S"] + 1))
+        batches.append({"data": toks[:, :-1].astype(np.int32),
+                        "label": toks[:, 1:].astype(np.int32)})
+    return solver, batches
+
+
+def test_two_process_pipeline_matches_single_process(tmp_path):
+    """A "pipe" mesh axis spanning 2 real processes: the GPipe stage
+    ppermute crosses host boundaries and both hosts see the identical
+    loss curve — which also matches the single-process run."""
+    outs = _run_workers(_WORKER_PP, tmp_path, n=2)
+    per = _collect(outs, "PP_LOSSES")
+    np.testing.assert_allclose([float(v) for v in per[0]],
+                               [float(v) for v in per[1]], rtol=1e-5)
+
+    solver, batches = _pp_solver_and_batches()   # same config, 1 process
+    ref = [float(solver.train_step(b)) for b in batches]
+    np.testing.assert_allclose([float(v) for v in per[0]], ref,
+                               rtol=1e-3, atol=1e-4)
