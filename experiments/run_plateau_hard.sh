@@ -1,0 +1,22 @@
+#!/bin/bash
+# Round-5 hard-mode plateau sweep (VERDICT r4 item 4): label_noise=0.3
+# surrogate caps attainable accuracy at 0.73, so DP-vs-local-SGD runs in a
+# contested band. Sequential on purpose (one core). tau=1 runs to the
+# plateau RULE (no special budget cap).
+cd "$(dirname "$0")/.."
+P=experiments/plateau_cifar.py
+L=_work/plateau
+mkdir -p results $L
+COMMON="--data _work/cifar20k_hard --min-images 360000 --max-images 1200000 --flat-window 5 --flat-eps 1.0"
+run() {
+    name=$1; shift
+    echo "=== $name: $* ==="
+    python $P "$@" $COMMON --metrics results/plateau_hard_${name}.jsonl \
+        > $L/hard_${name}.log 2>&1
+    echo "=== $name done rc=$? ==="
+}
+run dp_w4  --strategy dp --workers 4
+run t10_w4 --strategy local_sgd --tau 10 --workers 4
+run t50_w4 --strategy local_sgd --tau 50 --workers 4
+run t1_w4  --strategy local_sgd --tau 1 --workers 4
+echo "HARD SWEEP COMPLETE"

@@ -314,3 +314,28 @@ class TestSolverEndToEnd:
                  "label": np.asarray([1, 2], np.int32)}
         loss = float(s.train_step(batch))
         assert 1.5 < loss < 3.5
+
+
+def test_debug_info_dumps_blob_and_param_norms(capsys):
+    """SolverParameter.debug_info: per-top data norms + per-param
+    data/diff norms in the reference format (net.cpp ForwardDebugInfo /
+    BackwardDebugInfo), dumped at display points."""
+    from sparknet_tpu.models import zoo
+    sp = Message("SolverParameter", base_lr=0.01, lr_policy="fixed",
+                 display=1, random_seed=0, debug_info=True)
+    solver = Solver(sp, net_param=zoo.lenet(batch_size=2))
+    rs = np.random.RandomState(0)
+
+    def it():
+        while True:
+            yield {"data": rs.randn(2, 1, 28, 28).astype(np.float32),
+                   "label": rs.randint(0, 10, 2)}
+
+    solver.step(1, it())
+    out = capsys.readouterr().out
+    assert "[Forward] Layer conv1, top blob conv1 data:" in out
+    assert "[Forward] Layer conv1, param blob 0 data:" in out
+    assert "[Backward] Layer conv1, param blob 0 diff:" in out
+    # layer order preserved: the data layer prints before conv1
+    assert out.index("Layer data, top blob data") \
+        < out.index("Layer conv1, top blob conv1")
