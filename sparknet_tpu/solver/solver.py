@@ -225,11 +225,16 @@ class Solver:
     def _build_debug_fn(self):
         """SolverParameter.debug_info — per-blob/param mean-|x| dump in
         the reference format (net.cpp ForwardDebugInfo :658 + param
-        grads from BackwardDebugInfo). Deviation, documented: the
-        reference prints EVERY step; here the dump runs at display
-        points only (each line is a device fetch — per-step dumps would
-        serialize the async dispatch pipeline this solver is built on).
-        One fused jit computes every norm in a single device program."""
+        grads from BackwardDebugInfo). Deviations, documented: the
+        reference prints EVERY step mid-pass; here the dump runs at
+        display points only (each dump is a device fetch — per-step
+        dumps would serialize the async dispatch pipeline this solver is
+        built on), BEFORE the displayed iteration's update is applied,
+        so data/diff norms describe the same params that produced the
+        displayed loss. Dropout-style rng layers draw a different key
+        than the training step did, so their norms are same-distribution
+        rather than bit-identical. One fused jit computes every norm in
+        a single device program."""
         net = self.net
         tf = self.input_transform
 
@@ -400,6 +405,14 @@ class Solver:
                 micros = [next(data_iter) for _ in range(iter_size)]
                 batch = {k: np.stack([m[k] for m in micros])
                          for k in micros[0]}
+            # debug_info dumps run on PRE-update params (the state that
+            # produces this iteration's loss), like the reference's
+            # mid-step prints
+            if int(sp.debug_info) and sp.display \
+                    and self.iter % sp.display == 0:
+                micro = batch if iter_size == 1 \
+                    else {k: v[0] for k, v in batch.items()}
+                self._print_debug_info(micro)
             loss = self.train_step(batch)
             # deferred sync: losses stay device handles; fetching one is a
             # full round trip, so it happens at display points (or every
@@ -422,10 +435,6 @@ class Solver:
                 lr = float(self.lr_fn(self.iter - 1))
                 self.log(f"Iteration {self.iter - 1}, loss = {sm:.6g}, "
                          f"lr = {lr:.6g}")
-                if int(sp.debug_info):
-                    micro = batch if iter_size == 1 \
-                        else {k: v[0] for k, v in batch.items()}
-                    self._print_debug_info(micro)
                 if self.metrics:
                     dt = time.time() - t_last
                     steps = self.iter - it_last
