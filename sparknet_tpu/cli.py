@@ -480,24 +480,62 @@ def cmd_lm(args):
     print(f"bigram corpus floor: {floor:.4f} nats/token "
           f"(untrained: {np.log(args.vocab):.4f})")
 
-    if args.ep > 1 or args.dp > 1:
-        # dp x ep: ExpertParallelSolver (expert weights + optimizer state
-        # sharded over "expert", batch over both axes, all_to_all dispatch)
+    if args.ep > 1 or args.dp > 1 or args.sp > 1:
+        # mesh-axis solvers: --ep (x --dp x --sp) -> ExpertParallelSolver
+        # (expert weights + optimizer state sharded over "expert", batch
+        # over data/expert, sequence over "seq" with ring attention);
+        # --sp without MoE -> SeqParallelSolver (dp x sp); --dp alone ->
+        # DataParallelSolver
         if args.pipeline_stages > 1:
-            raise SystemExit("--ep/--dp cannot combine with "
+            raise SystemExit("--ep/--dp/--sp cannot combine with "
                              "--pipeline-stages")
-        if not args.moe_experts:
-            raise SystemExit("--ep/--dp need --moe-experts")
-        from .parallel import ExpertParallelSolver, make_mesh
+        if args.ep > 1 and not args.moe_experts:
+            raise SystemExit("--ep needs --moe-experts")
+        from .parallel import make_mesh
         from .models import zoo
+        if args.sp > 1:
+            lm_kw = dict(lm_kw, flash=False)   # ring attention path
         net = zoo.transformer_lm(num_layers=args.layers,
                                  moe_experts=args.moe_experts,
                                  moe_aux_weight=args.moe_aux_weight,
-                                 moe_stats=True, **lm_kw)
-        solver = ExpertParallelSolver(
-            sp, mesh=make_mesh({"data": args.dp, "expert": args.ep}),
-            net_param=net, metrics=metrics, dtype=dtype,
-            compute_dtype=compute_dtype)
+                                 moe_stats=bool(args.moe_experts),
+                                 ring=args.sp > 1, **lm_kw)
+        if args.moe_experts:
+            from .parallel import ExpertParallelSolver
+            axes = {"data": args.dp}
+            if args.sp > 1:
+                axes["seq"] = args.sp
+            axes["expert"] = args.ep
+            solver = ExpertParallelSolver(
+                sp, mesh=make_mesh(axes),
+                seq_axis="seq" if args.sp > 1 else None,
+                net_param=net, metrics=metrics, dtype=dtype,
+                compute_dtype=compute_dtype)
+        elif args.sp > 1:
+            from .parallel import SeqParallelSolver
+            solver = SeqParallelSolver(
+                sp, mesh=make_mesh({"data": args.dp, "seq": args.sp}),
+                net_param=net, metrics=metrics, dtype=dtype,
+                compute_dtype=compute_dtype)
+        else:
+            from .parallel import DataParallelSolver
+            solver = DataParallelSolver(
+                sp, mesh=make_mesh({"data": args.dp}), net_param=net,
+                metrics=metrics, dtype=dtype,
+                compute_dtype=compute_dtype)
+            import jax as _jax
+            if _jax.process_count() > 1:
+                # DataParallelSolver's multi-host discipline is per-host
+                # batch SLICES (unlike the global-feed EP/Seq branches);
+                # every host draws the identical seeded stream, so each
+                # takes its own slice of it
+                from .parallel import local_batch_slice
+
+                def _host_slice(it, B=args.batch):
+                    for b in it:
+                        s0, ln = local_batch_slice(B)
+                        yield {k: v[s0:s0 + ln] for k, v in b.items()}
+                stream = _host_slice(stream)
         if args.resume:
             solver.restore(args.resume)
         start_iter = solver.iter
@@ -505,6 +543,8 @@ def cmd_lm(args):
         chunk = args.display or 50
         while solver.iter < args.steps:
             solver.step(min(chunk, args.steps - solver.iter), stream)
+            if not args.moe_experts:
+                continue
             # routing diagnostics: one TEST-phase forward; the stats tops
             # (per-expert token fractions + overflow) pmean'd over the mesh
             scores = solver.test(iter([next(stream)]), num_iters=1)
@@ -763,6 +803,10 @@ def main(argv=None):
     lm.add_argument("--dp", type=int, default=1,
                     help="data-parallel ways composed with --ep "
                          "(mesh {data: dp, expert: ep})")
+    lm.add_argument("--sp", type=int, default=1,
+                    help="sequence-parallel ways composed with --ep: "
+                         "dp x sp x ep long-context MoE (ring attention "
+                         "over \"seq\")")
     lm.add_argument("--pipeline-stages", type=int, default=1,
                     help="N>1: run the trunk as an N-stage GPipe pipeline "
                          "over a pipe mesh axis (PipelineLMSolver)")

@@ -97,6 +97,24 @@ def place_tree(tree, specs, mesh):
                                   is_leaf=lambda s: isinstance(s, P))
 
 
+def check_seq_shardable_losses(net, solver_name):
+    """Sequence-sharded exactness (pmean of per-shard means == global
+    mean) requires every shard to normalize by the same token count; a
+    loss with ignore_label normalizes by its LOCAL valid count, so shards
+    with more padding would weigh their tokens more — silently biased
+    gradients. Refuse rather than mis-train."""
+    for lp, impl, _, _ in net.layers:
+        if getattr(impl, "ignore_label", None) is not None and \
+                net.loss_weights.get(lp.name) and \
+                any(net.loss_weights[lp.name]):
+            raise ValueError(
+                f"layer {lp.name!r}: ignore_label losses normalize by "
+                f"the per-shard valid-token count, which breaks "
+                f"{solver_name}'s equal-shard loss/grad exactness "
+                "(shards with more padding would be over-weighted). "
+                "Drop ignore_label or mask labels on the host instead.")
+
+
 def check_global_feed(batch):
     """First-step agreement check for the global-feed discipline (every
     host passes the SAME full batch; devices pull their own blocks): a

@@ -31,7 +31,12 @@ routing); with aux weight 0 the step is bit-equivalent to single-device.
 
 No reference twin: SURVEY.md section 2c lists EP/MoE as absent from the
 CNN-era reference; this solver completes the dp/tp/sp/ep/pp set with the
-same Solver API as the other axes.
+same Solver API as the other axes. ``seq_axis`` composes a third axis —
+dp x sp x ep, the long-context MoE shape: sequence dim sharded over
+"seq" (ring attention + positional offsets via parallel.context, as in
+SeqParallelSolver), expert dispatch still all_to_all over "expert"
+within each (data, seq) row; expert-param grads then pmean over BOTH
+data and seq before the 1/ep factor.
 """
 
 import numpy as np
@@ -41,7 +46,7 @@ from jax.sharding import PartitionSpec as P
 
 from ..solver.solver import Solver
 from .data_parallel import _rebatch, _batch_specs, shard_batch, \
-    check_global_feed, place_tree
+    check_global_feed, check_seq_shardable_losses, place_tree
 from . import context
 
 
@@ -56,7 +61,7 @@ class ExpertParallelSolver(Solver):
     _EXPERT_SLOTS = (1, 2, 3, 4)
 
     def __init__(self, solver_param, mesh=None, data_axis="data",
-                 expert_axis="expert", **kw):
+                 expert_axis="expert", seq_axis=None, **kw):
         from .mesh import make_mesh
         if jax.process_count() > 1 and int(solver_param.random_seed) < 0:
             raise ValueError(
@@ -66,14 +71,25 @@ class ExpertParallelSolver(Solver):
         self.mesh = mesh if mesh is not None else \
             make_mesh({data_axis: 1, expert_axis: -1})
         self.data_axis, self.expert_axis = data_axis, expert_axis
+        # optional third axis: dim 1 (sequence) sharded over "seq" — the
+        # dp x sp x ep long-context MoE composition. Sequence-aware
+        # layers (ring attention, positional-embed offsets, per-token
+        # loss) pick the axis up from parallel.context exactly as under
+        # SeqParallelSolver; the MoE all_to_all still runs over
+        # "expert" only (each (data, seq) shard's tokens route among
+        # that row's ep peers).
+        self.seq_axis = seq_axis
         if int(solver_param.iter_size) > 1:
             raise ValueError("ExpertParallelSolver does not support "
                              "iter_size > 1")
         super().__init__(solver_param, **kw)
+        if seq_axis:
+            check_seq_shardable_losses(self.net, "ExpertParallelSolver")
         dp = self.mesh.shape[data_axis]
         self.ep = ep = self.mesh.shape[expert_axis]
-        self.local_net = _rebatch(self.net, dp * ep)
-        self.local_test_net = _rebatch(self.test_net, dp * ep) \
+        sp = self.mesh.shape[seq_axis] if seq_axis else 1
+        self.local_net = _rebatch(self.net, dp * ep, seq=sp)
+        self.local_test_net = _rebatch(self.test_net, dp * ep, seq=sp) \
             if self.test_net is not None else None
         # per-param sharding specs ({layer: [spec per owned blob]}) + the
         # matching bool tree used to pick the gradient reduction
@@ -112,31 +128,43 @@ class ExpertParallelSolver(Solver):
         return place_tree(tree, specs, self.mesh)
 
     def _axes_context(self):
-        return context.axis_context(data=self.data_axis,
-                                    expert=self.expert_axis)
+        axes = dict(data=self.data_axis, expert=self.expert_axis)
+        if self.seq_axis:
+            axes["seq"] = self.seq_axis
+        return context.axis_context(**axes)
 
     def _batch_spec(self, batch):
-        return _batch_specs(batch, (self.data_axis, self.expert_axis))
+        return _batch_specs(batch, (self.data_axis, self.expert_axis),
+                            seq_axis=self.seq_axis)
 
     def _sharded_step(self, batch_example):
         net, updater, lr_fn = self.local_net, self.updater, self.lr_fn
         da, ea, ep = self.data_axis, self.expert_axis, self.ep
+        sa = self.seq_axis
+        # every non-expert mesh axis a token shard lives on; expert-param
+        # grads skip "expert" (each column owns distinct experts) but pay
+        # the 1/ep loss-normalization factor (module docstring)
+        other = [da] + ([sa] if sa else [])
         flags = self._expert_flags
         loss_fn = self._wrapped_loss(net)
+
+        def pmean_over(x, axes):
+            for a in axes:
+                x = jax.lax.pmean(x, a)
+            return x
 
         def reduce_grads(grads):
             def red(g, is_expert):
                 if is_expert:
-                    # contributions for this column's experts, summed over
-                    # its ep peers by the backward all_to_all; see module
-                    # docstring for the 1/ep factor
-                    return jax.lax.pmean(g, da) / ep
-                return jax.lax.pmean(jax.lax.pmean(g, ea), da)
+                    return pmean_over(g, other) / ep
+                return pmean_over(g, [ea] + other)
             return jax.tree_util.tree_map(red, grads, flags)
 
         def step(params, state, history, batch, it, rng):
-            flat_idx = jax.lax.axis_index(da) * jax.lax.axis_size(ea) \
-                + jax.lax.axis_index(ea)
+            flat_idx = jax.lax.axis_index(da)
+            for a in ([sa] if sa else []) + [ea]:
+                flat_idx = flat_idx * jax.lax.axis_size(a) \
+                    + jax.lax.axis_index(a)
             rng = jax.random.fold_in(rng, flat_idx)
 
             def lf(p):
@@ -145,8 +173,8 @@ class ExpertParallelSolver(Solver):
             (loss, state), grads = jax.value_and_grad(
                 lf, has_aux=True)(params)
             grads = reduce_grads(grads)
-            loss = jax.lax.pmean(jax.lax.pmean(loss, ea), da)
-            state = jax.lax.pmean(jax.lax.pmean(state, ea), da)
+            loss = pmean_over(loss, [ea] + other)
+            state = pmean_over(state, [ea] + other)
             params, history = updater(params, grads, history, lr_fn(it), it)
             return params, state, history, loss, it + 1
 
@@ -165,7 +193,7 @@ class ExpertParallelSolver(Solver):
     def _shard(self, batch):
         return shard_batch(batch, self.mesh,
                            (self.data_axis, self.expert_axis),
-                           global_feed=True)
+                           seq_axis=self.seq_axis, global_feed=True)
 
     def train_step(self, batch):
         import time as _time
@@ -195,13 +223,20 @@ class ExpertParallelSolver(Solver):
         tf = self.test_input_transform
         compiled = {}
 
+        sa = self.seq_axis
+        axes = [ea, da] + ([sa] if sa else [])
+
         def ev(params, state, batch):
             if tf is not None:
                 batch = tf(batch)
             blobs, _ = net.apply(params, state, batch, train=False)
-            return {b: jax.lax.pmean(jax.lax.pmean(
-                jnp.asarray(blobs[b], jnp.float32), ea), da)
-                    for b in net.output_blobs}
+            out = {}
+            for b in net.output_blobs:
+                v = jnp.asarray(blobs[b], jnp.float32)
+                for a in axes:
+                    v = jax.lax.pmean(v, a)
+                out[b] = v
+            return out
 
         def stepper(params, state, batch):
             key = tuple(sorted((k, tuple(np.shape(v)))

@@ -27,7 +27,7 @@ from jax.sharding import PartitionSpec as P
 
 from ..solver.solver import Solver
 from .data_parallel import _rebatch, _batch_specs, shard_batch, \
-    check_global_feed
+    check_global_feed, check_seq_shardable_losses
 from . import context
 
 
@@ -61,21 +61,7 @@ class SeqParallelSolver(Solver):
             raise ValueError("SeqParallelSolver does not support "
                              "iter_size > 1")
         super().__init__(solver_param, **kw)
-        # the exactness contract (pmean of per-shard means == global mean)
-        # requires every shard to normalize by the same token count; a loss
-        # with ignore_label normalizes by its LOCAL valid count, so shards
-        # with more padding would weigh their tokens more — silently biased
-        # gradients. Refuse rather than mis-train.
-        for lp, impl, _, _ in self.net.layers:
-            if getattr(impl, "ignore_label", None) is not None and \
-                    self.net.loss_weights.get(lp.name) and \
-                    any(self.net.loss_weights[lp.name]):
-                raise ValueError(
-                    f"layer {lp.name!r}: ignore_label losses normalize by "
-                    "the per-shard valid-token count, which breaks "
-                    "SeqParallelSolver's equal-shard loss/grad exactness "
-                    "(shards with more padding would be over-weighted). "
-                    "Drop ignore_label or mask labels on the host instead.")
+        check_seq_shardable_losses(self.net, "SeqParallelSolver")
         dp = self.mesh.shape[data_axis]
         sp = self.mesh.shape[seq_axis]
         self.local_net = _rebatch(self.net, dp, seq=sp)

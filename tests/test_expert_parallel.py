@@ -123,3 +123,30 @@ def test_moe_matches_dense_ffn_twin_at_matched_params():
     assert dense < start - 0.6 * (start - floor), (dense, start, floor)
     # ...and agree with each other
     assert abs(moe - dense) < 0.25, (moe, dense, floor)
+
+
+def test_ep_with_seq_axis_matches_single_device():
+    """dp=2 x sp=2 x ep=2 — the long-context MoE composition: ring
+    attention over "seq", MoE all_to_all over "expert", batch over all
+    three; loss curve equals the single-device run's (no-overflow
+    capacity, aux weight 0)."""
+    V, S, B, D = 32, 32, 8, 16
+    net = zoo.transformer_lm(vocab_size=V, seq_len=S, batch_size=B,
+                             d_model=D, num_layers=2, num_heads=2,
+                             flash=False, ring=True, moe_experts=2,
+                             moe_aux_weight=0.0, moe_capacity_factor=2.0)
+    ep = ExpertParallelSolver(
+        _sp(), mesh=make_mesh({"data": 2, "seq": 2, "expert": 2}),
+        seq_axis="seq", net_param=net)
+    ref = Solver(_sp(), net_param=zoo.transformer_lm(
+        vocab_size=V, seq_len=S, batch_size=B, d_model=D, num_layers=2,
+        num_heads=2, flash=False, ring=False, moe_experts=2,
+        moe_aux_weight=0.0, moe_capacity_factor=2.0))
+    el, rl = [], []
+    for b in _batches(6, B=B, S=S, V=V):
+        el.append(float(ep.train_step(b)))
+        rl.append(float(ref.train_step(b)))
+    np.testing.assert_allclose(el, rl, rtol=1e-4, atol=1e-5)
+    # expert weights sharded over "expert" (1 of 2 experts per column)
+    w1 = ep.params["block0/moe"][1]
+    assert w1.addressable_shards[0].data.shape[0] == 1
