@@ -366,6 +366,10 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--windows", type=int, default=WINDOWS,
                     help="timing windows per row (spread is recorded)")
+    ap.add_argument("--metrics", default="bench_metrics.jsonl",
+                    help="JSONL metrics stream for every bench row "
+                         "(BENCH_*.json provenance reproducible from the "
+                         "JSONL alone; '' disables)")
     ap.add_argument("--project", action="store_true",
                     help="print the analytic multi-chip projection from "
                          "the measured single-chip rows and exit")
@@ -391,6 +395,15 @@ def main():
     peak = next((v for k, v in _PEAK.items()
                  if k.lower() in dev.device_kind.lower()), None)
     rows = []
+    # every row also goes through the structured metrics stream
+    # (sparknet_tpu.obs backend), so `sparknet report bench_metrics.jsonl`
+    # reconstructs a BENCH_*.json's provenance from the JSONL alone
+    from sparknet_tpu.utils.metrics import MetricsLogger
+    mlog = MetricsLogger(args.metrics) if args.metrics else None
+    if mlog:
+        mlog.log("bench_config", device=dev.device_kind,
+                 platform=dev.platform, peak_bf16_flops=peak,
+                 windows=WINDOWS, warmup=WARMUP, iters_per_window=ITERS)
 
     def emit(row):
         # stream rows as they finish: a killed/timed-out run still leaves
@@ -399,6 +412,8 @@ def main():
         import os
         rows.append(row)
         print("#BENCH " + json.dumps(row), file=sys.stderr, flush=True)
+        if mlog:
+            mlog.log("bench", **row)
         with open("bench_details.json.tmp", "w") as f:
             json.dump({"device": dev.device_kind, "platform": dev.platform,
                        "peak_bf16_flops": peak, "rows": rows}, f, indent=1)
@@ -410,13 +425,16 @@ def main():
     head, solver = bench_synthetic(
         "caffenet", zoo.caffenet(batch_size=256, num_classes=1000),
         256, (3, 227, 227), 1000, peak)
-    print(json.dumps({
+    headline = {
         "metric": "caffenet_train_throughput",
         "value": head["images_per_sec"],
         "unit": "images/sec",
         "vs_baseline": round(head["images_per_sec"] / BASELINE_IMG_PER_SEC,
                              3),
-    }), flush=True)
+    }
+    print(json.dumps(headline), flush=True)
+    if mlog:
+        mlog.log("bench_headline", **headline)
     emit(head)
 
     del solver
@@ -473,6 +491,8 @@ def main():
     except Exception as e:
         print(f"#BENCH-SKIP transformer_lm_1024: {e}", file=sys.stderr,
               flush=True)
+    if mlog:
+        mlog.close()
 
 
 if __name__ == "__main__":

@@ -1,0 +1,71 @@
+#!/usr/bin/env bash
+# Observability smoke test (ISSUE 1 acceptance, CI-runnable on CPU):
+# a 5-step synthetic train with metrics + trace enabled must produce
+#   (a) a JSONL with step/span/comms/recompile events (host/device split)
+#   (b) a well-formed Chrome trace_event span file
+#   (c) a `sparknet report` that renders and writes valid JSON.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+export JAX_PLATFORMS=cpu
+export PYTHONPATH="${PYTHONPATH:-}:$(pwd)"
+
+tmp=$(mktemp -d)
+trap 'rm -rf "$tmp"' EXIT
+
+cat > "$tmp/net.prototxt" <<'EOF'
+name: "smoke_cifar_synth"
+layer { name: "data" type: "JavaData" top: "data"
+        java_data_param { shape { dim: 8 dim: 3 dim: 32 dim: 32 } } }
+layer { name: "label" type: "JavaData" top: "label"
+        java_data_param { shape { dim: 8 } } }
+layer { name: "conv1" type: "Convolution" bottom: "data" top: "conv1"
+        convolution_param { num_output: 8 kernel_size: 5 stride: 2
+                            weight_filler { type: "xavier" } } }
+layer { name: "relu1" type: "ReLU" bottom: "conv1" top: "conv1" }
+layer { name: "fc" type: "InnerProduct" bottom: "conv1" top: "fc"
+        inner_product_param { num_output: 10
+                              weight_filler { type: "xavier" } } }
+layer { name: "loss" type: "SoftmaxWithLoss" bottom: "fc" bottom: "label"
+        top: "loss" }
+EOF
+
+cat > "$tmp/solver.prototxt" <<'EOF'
+net: "net.prototxt"
+base_lr: 0.01
+lr_policy: "fixed"
+display: 2
+max_iter: 5
+random_seed: 0
+EOF
+
+python -m sparknet_tpu train --solver "$tmp/solver.prototxt" \
+    --iterations 5 --metrics "$tmp/run.jsonl" --profile "$tmp/trace"
+
+python - "$tmp" <<'EOF'
+import json, sys, os
+tmp = sys.argv[1]
+lines = open(os.path.join(tmp, "run.jsonl")).read().splitlines()
+events = [json.loads(l) for l in lines]         # every line must parse
+kinds = {e["event"] for e in events}
+missing = {"step", "span", "comms", "recompile"} - kinds
+assert not missing, f"missing event kinds: {missing} (got {sorted(kinds)})"
+step = next(e for e in events if e["event"] == "step")
+assert "host_ms" in step and "device_ms" in step, step
+chrome = json.load(open(os.path.join(tmp, "trace", "spans.trace.json")))
+assert chrome["traceEvents"], "empty chrome trace"
+print(f"JSONL OK: {len(events)} events, kinds {sorted(kinds)}")
+print(f"Chrome trace OK: {len(chrome['traceEvents'])} span events")
+EOF
+
+python -m sparknet_tpu report "$tmp/run.jsonl" --json "$tmp/report.json"
+
+python - "$tmp" <<'EOF'
+import json, sys, os
+rep = json.load(open(os.path.join(sys.argv[1], "report.json")))
+assert rep["steps"]["steps"] == 5, rep.get("steps")
+assert rep["comms"]["h2d_bytes_total"] > 0
+assert rep["phases"], "no per-phase breakdown"
+print("report JSON OK")
+EOF
+
+echo "SMOKE OK"

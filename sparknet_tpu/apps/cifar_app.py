@@ -41,6 +41,11 @@ class CifarApp:
         self.t0 = time.time()
         self.logf = open(log_path, "w") if log_path else None
         self.metrics_path = metrics_path
+        # one metrics stream for the whole app: the solver's step/comms
+        # accounting (sparknet_tpu.obs), the watchdog, and the app's own
+        # round/test events share it, so `sparknet report` sees the run
+        from ..utils.metrics import MetricsLogger
+        self.metrics = MetricsLogger(metrics_path) if metrics_path else None
         self.rng = np.random.RandomState(seed)
         self._train_f32 = None
         from ..parallel import distributed_init
@@ -78,10 +83,12 @@ class CifarApp:
 
         if strategy == "local_sgd":
             self.solver = LocalSGDSolver(solver_param, mesh=mesh, tau=tau,
-                                         net_param=net, log_fn=self.log)
+                                         net_param=net, log_fn=self.log,
+                                         metrics=self.metrics)
         else:
             self.solver = DataParallelSolver(solver_param, mesh=mesh,
-                                             net_param=net, log_fn=self.log)
+                                             net_param=net, log_fn=self.log,
+                                             metrics=self.metrics)
         self.log(f"initialized: {self.num_workers} workers, "
                  f"strategy={strategy}")
 
@@ -156,18 +163,17 @@ class CifarApp:
     def run(self, num_rounds=100, test_every=10, stall_seconds=600.0):
         from ..data.prefetch import PrefetchIterator
         from ..utils.watchdog import Watchdog
-        from ..utils.metrics import MetricsLogger
 
-        metrics = MetricsLogger(path=self.metrics_path) \
-            if self.metrics_path else None
+        metrics = self.metrics
         steps_per_round = self.solver.tau \
             if self.strategy == "local_sgd" else 1
         imgs_per_round = TRAIN_BATCH * self.num_workers * steps_per_round
-        wd = Watchdog(stall_seconds=stall_seconds,
+        wd = Watchdog(stall_seconds=stall_seconds, metrics=metrics,
                       on_stall=lambda dt: self.log(
                           f"WATCHDOG: no round finished in {dt:.0f}s"),
                       on_nan=lambda v: self.log(f"WATCHDOG: loss = {v}"))
-        batches = PrefetchIterator(self._round_stream(), depth=2)
+        batches = PrefetchIterator(self._round_stream(), depth=2,
+                                   metrics=metrics, name="round_feed")
         try:
             with wd:
                 for r in range(num_rounds):
@@ -198,6 +204,7 @@ class CifarApp:
                                                        / max(dt, 1e-9), 1))
         finally:
             batches.close()
+            self.solver.close()     # flush step/comms summaries
             if metrics:
                 metrics.close()
         return self.solver

@@ -31,6 +31,9 @@ class ImageNetApp:
         self.t0 = time.time()
         self.logf = open(log_path, "w") if log_path else None
         self.metrics_path = metrics_path
+        # shared stream: app round/test events + solver obs accounting
+        from ..utils.metrics import MetricsLogger
+        self.metrics = MetricsLogger(metrics_path) if metrics_path else None
         from ..parallel import distributed_init
         distributed_init()      # no-op single-process (DEPLOY.md)
         mesh = make_mesh({"data": num_workers if num_workers else -1})
@@ -62,10 +65,12 @@ class ImageNetApp:
             display=0, random_seed=seed)
         if strategy == "local_sgd":
             self.solver = LocalSGDSolver(solver_param, mesh=mesh, tau=tau,
-                                         net_param=net, log_fn=self.log)
+                                         net_param=net, log_fn=self.log,
+                                         metrics=self.metrics)
         else:
             self.solver = DataParallelSolver(solver_param, mesh=mesh,
-                                             net_param=net, log_fn=self.log)
+                                             net_param=net, log_fn=self.log,
+                                             metrics=self.metrics)
         self.log(f"initialized: {self.num_workers} workers, "
                  f"strategy={strategy}, batch={batch * scale}")
 
@@ -124,17 +129,16 @@ class ImageNetApp:
             stall_seconds=1200.0):
         from ..data.prefetch import PrefetchIterator
         from ..utils.watchdog import Watchdog
-        from ..utils.metrics import MetricsLogger
 
-        metrics = MetricsLogger(path=self.metrics_path) \
-            if self.metrics_path else None
+        metrics = self.metrics
         steps = self.solver.tau if self.strategy == "local_sgd" else 1
         imgs_per_round = self.batch * self.num_workers * steps
-        wd = Watchdog(stall_seconds=stall_seconds,
+        wd = Watchdog(stall_seconds=stall_seconds, metrics=metrics,
                       on_stall=lambda dt: self.log(
                           f"WATCHDOG: no round finished in {dt:.0f}s"),
                       on_nan=lambda v: self.log(f"WATCHDOG: loss = {v}"))
-        batches = PrefetchIterator(self._round_stream(), depth=2)
+        batches = PrefetchIterator(self._round_stream(), depth=2,
+                                   metrics=metrics, name="round_feed")
         try:
             with wd:
                 for r in range(num_rounds):
@@ -171,6 +175,7 @@ class ImageNetApp:
                                         imgs_per_round / max(dt, 1e-9), 1))
         finally:
             batches.close()
+            self.solver.close()     # flush step/comms summaries
             if metrics:
                 metrics.close()
         return self.solver

@@ -121,9 +121,16 @@ def cmd_train(args):
     from .proto import text_format
     from .solver.solver import Solver, resolve_nets
     from .utils.signals import SignalPolicy
+    from .utils.metrics import MetricsLogger
     from .data.prefetch import PrefetchIterator
+    from .obs import Tracer, JaxProfiler
 
     import os
+    # one metrics stream + span tracer for the whole run: the solver's
+    # step/comms accounting, the prefetch gauges, and the CLI's phase
+    # spans all land in the same JSONL (see sparknet_tpu.obs)
+    metrics = MetricsLogger(args.metrics) if args.metrics else None
+    tracer = Tracer(metrics)
     sp = text_format.load(args.solver, "SolverParameter")
     base_dir = _net_base_dir(sp, args.solver)
     if sp.has("snapshot_prefix") and base_dir \
@@ -151,16 +158,18 @@ def cmd_train(args):
             test_src = maybe_device_cache(test_src, budget, iter_size=isz)
     feed = {**(train_shapes or {}), **_feed_shapes_arg(args.input_shape)}
 
-    if args.strategy == "dp":
-        from .parallel import DataParallelSolver, make_mesh
-        solver = DataParallelSolver(sp, mesh=make_mesh(_mesh_arg(args.mesh))
-                                    if args.mesh else None, base_dir=base_dir,
-                                    feed_shapes=feed or None,
-                                    test_feed_shapes=test_shapes,
-                                    metrics=args.metrics)
-    else:
-        solver = Solver(sp, base_dir=base_dir, feed_shapes=feed or None,
-                        test_feed_shapes=test_shapes, metrics=args.metrics)
+    with tracer.span("setup", strategy=args.strategy):
+        if args.strategy == "dp":
+            from .parallel import DataParallelSolver, make_mesh
+            solver = DataParallelSolver(
+                sp, mesh=make_mesh(_mesh_arg(args.mesh))
+                if args.mesh else None, base_dir=base_dir,
+                feed_shapes=feed or None, test_feed_shapes=test_shapes,
+                metrics=metrics, tracer=tracer)
+        else:
+            solver = Solver(sp, base_dir=base_dir, feed_shapes=feed or None,
+                            test_feed_shapes=test_shapes, metrics=metrics,
+                            tracer=tracer)
     # device-transform mode: the source yields raw uint8 records + offset
     # arrays; crop/mirror/mean run inside the jitted step (3-4x fewer H2D
     # bytes — data/device_transform.py). Must install before first compile.
@@ -195,7 +204,8 @@ def cmd_train(args):
         print(f"Training from {train_src.source} "
               f"({train_src.num_records} records, {kind})")
         data_iter = PrefetchIterator(iter(train_src), depth=3,
-                                     transform=put)
+                                     transform=put, metrics=metrics,
+                                     name="train_feed")
     else:
         print("WARNING: no Data-layer LMDB source found; "
               "feeding synthetic noise (shapes only)")
@@ -213,30 +223,17 @@ def cmd_train(args):
         sp.snapshot_prefix if sp.has("snapshot_prefix") else None)
     policy = SignalPolicy(sigint=args.sigint_effect,
                           sighup=args.sighup_effect)
-    profiling = profiled = False
+    prof = JaxProfiler(args.profile)
     blocks_done = 0
     try:
         with policy:
             while solver.iter < total:
-                if args.profile and not profiled and not profiling \
-                        and (blocks_done >= 1 or total - solver.iter <= 100):
-                    # skip the compile-heavy first block of THIS process
-                    # (fresh start or snapshot resume alike) so the trace
-                    # shows steady-state device time (XLA ops, HBM, infeed);
-                    # single-block runs trace their only block
-                    import jax
-                    jax.profiler.start_trace(args.profile)
-                    profiling = True
+                prof.maybe_start(blocks_done, total - solver.iter)
                 n = min(100, total - solver.iter)
-                solver.step(n, data_iter, test_data_fn=test_fn)
+                with tracer.span("train_block", iter0=solver.iter, iters=n):
+                    solver.step(n, data_iter, test_data_fn=test_fn)
                 blocks_done += 1
-                if profiling:
-                    import jax
-                    jax.profiler.stop_trace()
-                    profiling = False
-                    profiled = True
-                    print(f"Wrote profiler trace to {args.profile} "
-                          "(view with tensorboard or xprof)")
+                prof.maybe_stop()
                 action = policy.pending()
                 if action == "snapshot":
                     solver.snapshot(prefix=prefix or "snap")
@@ -244,19 +241,18 @@ def cmd_train(args):
                     print("stopping early on signal")
                     break
     finally:
-        if profiling:
-            # flush the trace of the block that raised — it's the one
-            # most worth looking at
-            import jax
-            try:
-                jax.profiler.stop_trace()
-            except Exception:
-                pass
+        prof.abort()
         if train_src is not None:
             data_iter.close()
             train_src.close()
         if test_src is not None:
             test_src.close()
+        solver.close()          # watchdog thread + step/comms summaries
+        if args.profile:
+            # the host-side twin of the device trace: the run's spans in
+            # Chrome trace_event format, next to jax.profiler's output
+            tracer.export_chrome(os.path.join(args.profile,
+                                              "spans.trace.json"))
     # final snapshot unless disabled or this iter was already snapshotted
     # by the in-loop cadence (reference solver.cpp Solve tail :300-306,
     # snapshot_after_train). The cadence path only fires when the
@@ -267,6 +263,8 @@ def cmd_train(args):
     if prefix and sp.snapshot_after_train and not cadence_fired:
         solver.snapshot(prefix=prefix)
     print(f"Optimization done, iter={solver.iter}")
+    if metrics:
+        metrics.close()
     return 0
 
 
@@ -613,6 +611,22 @@ def cmd_lm(args):
         metrics.log("summary", steps=executed,
                     tokens_per_sec=round(rate, 1),
                     final_loss=final, loss_floor_nats=round(floor, 4))
+    if hasattr(solver, "close"):
+        solver.close()          # flush step/comms summaries, stop threads
+    if metrics:
+        metrics.close()
+    return 0
+
+
+def cmd_report(args):
+    """Aggregate a --metrics JSONL into a run report (sparknet_tpu.obs):
+    per-phase time breakdown, step-time percentiles, comms volume,
+    recompile count, loss-curve summary — human-readable on stdout,
+    machine-readable with --json, Chrome trace_event spans with
+    --chrome."""
+    from .obs import report as obs_report
+    obs_report.report_file(args.jsonl, json_out=args.json,
+                           chrome_out=args.chrome)
     return 0
 
 
@@ -817,6 +831,17 @@ def main(argv=None):
     lm.add_argument("--resume", help=".lm.npz (pipeline) or "
                                      ".solverstate.h5 to resume from")
     lm.set_defaults(fn=cmd_lm)
+
+    rp = sub.add_parser("report",
+                        help="aggregate a --metrics JSONL into a run "
+                             "report (phases, step percentiles, comms, "
+                             "recompiles, loss curve)")
+    rp.add_argument("jsonl", help="metrics JSONL written by --metrics")
+    rp.add_argument("--json", help="also write machine-readable report "
+                                   "JSON here (BENCH_*.json-comparable)")
+    rp.add_argument("--chrome", help="also export the run's spans as a "
+                                     "Chrome trace_event file")
+    rp.set_defaults(fn=cmd_report)
 
     i = sub.add_parser("imagenet", help="ImageNetApp driver")
     i.add_argument("--workers", type=int, default=None)

@@ -24,9 +24,16 @@ class PrefetchIterator:
                mean native transform, or jax.device_put for H2D overlap).
     workers > 1 preserves NO ordering guarantees (like the reference's
     single reader it defaults to 1, which does).
+    metrics: optional utils.metrics.MetricsLogger; queue-depth gauges are
+             emitted as ``prefetch`` events every ``emit_every`` consumer
+             gets (and once at close). An empty queue at get time means the
+             consumer is about to block on the producer — a sustained
+             empty_frac near 1.0 says the input pipeline, not the device,
+             is the bound.
     """
 
-    def __init__(self, source, depth=2, transform=None, workers=1):
+    def __init__(self, source, depth=2, transform=None, workers=1,
+                 metrics=None, name="prefetch", emit_every=100):
         self._q = queue.Queue(maxsize=depth)
         self._transform = transform
         self._stop = threading.Event()
@@ -34,6 +41,14 @@ class PrefetchIterator:
         self._error = None
         self._source = iter(source)
         self._src_lock = threading.Lock()
+        self._metrics = metrics
+        self._name = name
+        self._emit_every = max(1, emit_every)
+        self._depth = depth
+        self._gets = 0
+        self._depth_sum = 0
+        self._empty_gets = 0
+        self._stats_emitted = False
         self._threads = [
             threading.Thread(target=self._run, daemon=True,
                              name=f"sparknet-prefetch-{i}")
@@ -77,6 +92,13 @@ class PrefetchIterator:
             if self._error is not None:
                 raise self._error
             raise StopIteration
+        d = self._q.qsize()          # approximate, fine for a gauge
+        self._gets += 1
+        self._depth_sum += d
+        if d == 0:
+            self._empty_gets += 1
+        if self._metrics is not None and self._gets % self._emit_every == 0:
+            self._emit_stats()
         item = self._q.get()
         if item is _END:
             self._done = True
@@ -85,7 +107,21 @@ class PrefetchIterator:
             raise StopIteration
         return item
 
+    def stats(self):
+        """Queue-depth gauges over the consumer's gets so far."""
+        g = self._gets
+        return {"name": self._name, "gets": g, "depth_cap": self._depth,
+                "depth_mean": round(self._depth_sum / g, 3) if g else None,
+                "empty_frac": round(self._empty_gets / g, 3) if g else None}
+
+    def _emit_stats(self):
+        self._metrics.log("prefetch", **self.stats())
+
     def close(self):
+        if self._metrics is not None and self._gets \
+                and not self._stats_emitted:
+            self._stats_emitted = True
+            self._emit_stats()
         self._done = True
         self._stop.set()
         # drain so producers blocked on put() can exit; a worker error that

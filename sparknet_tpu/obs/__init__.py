@@ -1,0 +1,34 @@
+"""Observability subsystem: tracing, step accounting, comms metering.
+
+The SparkNet paper's central result is a communication/compute tradeoff
+(tau local steps vs. broadcast/collect cost), but the reference had no
+structured way to measure it — loss and timing went to glog and ad-hoc
+prints (SURVEY.md section 5). This package is the measurement layer every
+perf PR reports against:
+
+  trace.py      nested span tracer (JSONL events + Chrome trace_event
+                export) and the steady-state jax.profiler toggle
+  stepstats.py  host-dispatch vs device-wall step accounting, recompile
+                detection, p50/p95/p99 step-time histograms
+  comms.py      bytes moved per sync round (ring-allreduce cost model,
+                mapped to the paper's broadcast/collect model), plus
+                host->device feed byte counters
+  report.py     `sparknet report`: aggregate a metrics JSONL into a
+                human-readable run report + machine-readable JSON
+
+Everything writes through one utils.metrics.MetricsLogger, so a single
+JSONL stream carries spans, steps, comms, recompiles, watchdog barks,
+prefetch gauges, and the training curve together.
+"""
+
+from .trace import Tracer, JaxProfiler, chrome_from_spans, export_chrome
+from .stepstats import StepAccounting, percentiles, device_memory
+from .comms import (CommsMeter, tree_bytes, ring_allreduce_bytes,
+                    broadcast_collect_bytes, all_to_all_bytes)
+
+__all__ = [
+    "Tracer", "JaxProfiler", "chrome_from_spans", "export_chrome",
+    "StepAccounting", "percentiles", "device_memory",
+    "CommsMeter", "tree_bytes", "ring_allreduce_bytes",
+    "broadcast_collect_bytes", "all_to_all_bytes",
+]

@@ -1,0 +1,303 @@
+"""`sparknet report` — aggregate a metrics JSONL into a run report.
+
+Input: the JSONL a run writes via --metrics (spans, steps, comms,
+recompiles, train/test curve, watchdog, prefetch, bench rows — see the
+obs package docstring). Output: a human-readable per-phase breakdown on
+stdout and, with --json, a machine-readable report suitable for
+BENCH_*.json-style comparison across runs.
+
+Aggregation is pure dict-munging over parsed lines — no jax, no solver
+imports — so the report verb works on any machine, including ones
+without an accelerator stack.
+"""
+
+import collections
+import json
+
+from .stepstats import percentiles
+
+
+def load_events(path):
+    """Parse a JSONL file -> (events, malformed_line_count)."""
+    events, bad = [], 0
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                ev = json.loads(line)
+            except ValueError:
+                bad += 1
+                continue
+            if isinstance(ev, dict):
+                events.append(ev)
+            else:
+                bad += 1
+    return events, bad
+
+
+def _num(v):
+    return isinstance(v, (int, float)) and not isinstance(v, bool)
+
+
+def aggregate(events):
+    """Events -> report dict (all keys optional except counts)."""
+    by_type = collections.Counter(e.get("event", "?") for e in events)
+    rep = {"num_events": len(events), "events_by_type": dict(by_type)}
+
+    # -- spans: per-name rollup + top-level phase breakdown ----------------
+    spans = [e for e in events if e.get("event") == "span"]
+    if spans:
+        names = collections.defaultdict(lambda: {"count": 0, "total_ms": 0.0,
+                                                 "max_ms": 0.0})
+        for s in spans:
+            d = float(s.get("dur_ms") or 0.0)
+            r = names[s.get("name", "?")]
+            r["count"] += 1
+            r["total_ms"] += d
+            r["max_ms"] = max(r["max_ms"], d)
+        for r in names.values():
+            r["total_ms"] = round(r["total_ms"], 3)
+            r["mean_ms"] = round(r["total_ms"] / r["count"], 3)
+            r["max_ms"] = round(r["max_ms"], 3)
+        rep["spans"] = dict(names)
+        top = [s for s in spans if not s.get("depth")]
+        total_top = sum(float(s.get("dur_ms") or 0.0) for s in top) or 1.0
+        phases = collections.defaultdict(float)
+        for s in top:
+            phases[s.get("name", "?")] += float(s.get("dur_ms") or 0.0)
+        rep["phases"] = [
+            {"phase": k, "total_ms": round(v, 3),
+             "pct": round(100.0 * v / total_top, 1)}
+            for k, v in sorted(phases.items(), key=lambda kv: -kv[1])]
+
+    # -- steps: prefer the flushed full-histogram summary ------------------
+    summaries = [e for e in events if e.get("event") == "step_summary"]
+    steps = [e for e in events if e.get("event") == "step"]
+    if summaries:
+        s = dict(summaries[-1])
+        s.pop("event", None)
+        s.pop("t", None)
+        rep["steps"] = s
+    elif steps:
+        host = [e["host_ms"] for e in steps if _num(e.get("host_ms"))]
+        dev = [e["device_ms"] for e in steps if _num(e.get("device_ms"))]
+        st = {"sampled_steps": len(steps)}
+        st.update({f"host_ms_{k}": round(v, 3)
+                   for k, v in percentiles(host).items()})
+        st.update({f"device_ms_{k}": round(v, 3)
+                   for k, v in percentiles(dev).items()})
+        rep["steps"] = st
+
+    recompiles = [e for e in events if e.get("event") == "recompile"]
+    if recompiles:
+        rep["recompiles"] = {
+            "count": sum(1 for e in recompiles if not e.get("first")),
+            "first_compile_iters": [e.get("iter") for e in recompiles
+                                    if e.get("first")],
+            "unexpected": [{"iter": e.get("iter"),
+                            "reason": e.get("reason")}
+                           for e in recompiles if not e.get("first")][:50]}
+
+    # -- comms -------------------------------------------------------------
+    comms = [e for e in events if e.get("event") == "comms"]
+    if comms:
+        last = comms[-1]
+        c = {"h2d_bytes_total": last.get("h2d_bytes_total"),
+             "collective_bytes_per_step":
+                 last.get("collective_bytes_per_step"),
+             "collectives": last.get("collectives", [])}
+        for k in ("strategy", "n_devices", "axes", "param_bytes"):
+            if k in last:
+                c[k] = last[k]
+        rep["comms"] = c
+
+    # -- training curve ----------------------------------------------------
+    train = [e for e in events if e.get("event") == "train"
+             and _num(e.get("loss"))]
+    if train:
+        losses = [e["loss"] for e in train]
+        t = {"points": len(train),
+             "first_loss": round(losses[0], 6),
+             "final_loss": round(losses[-1], 6),
+             "min_loss": round(min(losses), 6)}
+        its = [e.get("iter") for e in train if _num(e.get("iter"))]
+        if its:
+            t["first_iter"], t["last_iter"] = its[0], its[-1]
+        for rate in ("images_per_sec", "tokens_per_sec", "images_per_s"):
+            vals = [e[rate] for e in train if _num(e.get(rate))]
+            if vals:
+                t[rate] = {"mean": round(sum(vals) / len(vals), 1),
+                           "last": round(vals[-1], 1)}
+        rep["train"] = t
+    tests = [e for e in events if e.get("event") == "test"]
+    if tests:
+        last = tests[-1]
+        rep["test"] = {k: v for k, v in last.items()
+                       if k not in ("event", "t", "run")}
+    summary = [e for e in events if e.get("event") == "summary"]
+    if summary:
+        rep["summary"] = {k: v for k, v in summary[-1].items()
+                          if k not in ("event", "t", "run")}
+
+    # -- auxiliary streams -------------------------------------------------
+    wd = [e for e in events if e.get("event") == "watchdog"]
+    if wd:
+        rep["watchdog"] = dict(collections.Counter(
+            e.get("kind", "?") for e in wd))
+    pf = [e for e in events if e.get("event") == "prefetch"]
+    if pf:
+        last = pf[-1]
+        rep["prefetch"] = {k: v for k, v in last.items()
+                           if k not in ("event", "t", "run")}
+    hbm = [e for e in events if e.get("event") == "hbm"]
+    if hbm:
+        peaks = [e.get("peak_bytes_in_use") or e.get("bytes_in_use") or 0
+                 for e in hbm]
+        rep["hbm"] = {"samples": len(hbm),
+                      "peak_bytes_in_use": max(peaks)}
+    bench = [e for e in events if e.get("event") == "bench"]
+    if bench:
+        rep["bench"] = [{k: v for k, v in e.items()
+                         if k not in ("event", "t", "run")} for e in bench]
+    return rep
+
+
+def _fmt_bytes(n):
+    if not _num(n):
+        return "?"
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024 or unit == "TiB":
+            return f"{n:.1f} {unit}" if unit != "B" else f"{int(n)} B"
+        n /= 1024.0
+    return "?"
+
+
+def render(rep):
+    """Report dict -> human-readable text."""
+    L = []
+
+    def hdr(s):
+        L.append("")
+        L.append(s)
+        L.append("-" * len(s))
+
+    L.append(f"run report: {rep.get('num_events', 0)} events "
+             f"({', '.join(f'{k}:{v}' for k, v in sorted(rep.get('events_by_type', {}).items()))})")
+    if rep.get("malformed_lines"):
+        L.append(f"WARNING: {rep['malformed_lines']} malformed JSONL lines "
+                 "skipped")
+
+    if rep.get("phases"):
+        hdr("per-phase time breakdown (top-level spans)")
+        for p in rep["phases"]:
+            L.append(f"  {p['phase']:<24} {p['total_ms']:>12.1f} ms "
+                     f"{p['pct']:>5.1f}%")
+
+    st = rep.get("steps")
+    if st:
+        hdr("step times")
+        L.append(f"  steps observed: {st.get('steps', st.get('sampled_steps', '?'))}")
+        for kind in ("host", "device"):
+            ps = {q: st.get(f"{kind}_ms_{q}") for q in ("p50", "p95", "p99")}
+            if any(_num(v) for v in ps.values()):
+                L.append(f"  {kind + ' ms':<10} " + "  ".join(
+                    f"{q}={ps[q]:.3f}" for q in ("p50", "p95", "p99")
+                    if _num(ps[q])))
+        if _num(st.get("recompiles")):
+            L.append(f"  recompiles (beyond first): {st['recompiles']}")
+    rc = rep.get("recompiles")
+    if rc:
+        hdr("recompiles")
+        L.append(f"  first compiles at iters: {rc.get('first_compile_iters')}")
+        L.append(f"  unexpected recompiles: {rc.get('count', 0)}")
+        for u in rc.get("unexpected", [])[:10]:
+            L.append(f"    iter {u.get('iter')}: {u.get('reason')}")
+
+    c = rep.get("comms")
+    if c:
+        hdr("communication")
+        if c.get("strategy"):
+            line = f"  strategy: {c['strategy']} over " \
+                   f"{c.get('n_devices', '?')} device(s)"
+            if c.get("axes"):
+                line += f", mesh axes {c['axes']}"
+            L.append(line)
+        L.append(f"  host->device feed total: "
+                 f"{_fmt_bytes(c.get('h2d_bytes_total'))}")
+        L.append(f"  collective volume/step (per chip): "
+                 f"{_fmt_bytes(c.get('collective_bytes_per_step'))}")
+        for col in c.get("collectives", []):
+            per = col.get("bytes_per_round", 0)
+            tau = col.get("steps_per_round", 1)
+            line = (f"    {col.get('kind'):<22} "
+                    f"{_fmt_bytes(per)}/round, every {tau} step(s)")
+            if col.get("paper_broadcast_collect_bytes"):
+                line += (" (paper broadcast+collect: "
+                         f"{_fmt_bytes(col['paper_broadcast_collect_bytes'])})")
+            L.append(line)
+
+    t = rep.get("train")
+    if t:
+        hdr("loss curve")
+        L.append(f"  {t.get('points')} display points, iters "
+                 f"{t.get('first_iter', '?')}..{t.get('last_iter', '?')}")
+        L.append(f"  loss {t.get('first_loss')} -> {t.get('final_loss')} "
+                 f"(min {t.get('min_loss')})")
+        for rate in ("images_per_sec", "tokens_per_sec", "images_per_s"):
+            if rate in t:
+                L.append(f"  {rate}: mean {t[rate]['mean']} "
+                         f"last {t[rate]['last']}")
+    if rep.get("test"):
+        hdr("last test scores")
+        for k, v in sorted(rep["test"].items()):
+            L.append(f"  {k} = {v}")
+    if rep.get("summary"):
+        hdr("run summary event")
+        for k, v in sorted(rep["summary"].items()):
+            L.append(f"  {k} = {v}")
+
+    if rep.get("watchdog"):
+        hdr("watchdog")
+        for k, v in sorted(rep["watchdog"].items()):
+            L.append(f"  {k}: {v}")
+    if rep.get("prefetch"):
+        hdr("prefetch (last gauge)")
+        for k, v in sorted(rep["prefetch"].items()):
+            L.append(f"  {k} = {v}")
+    if rep.get("hbm"):
+        hdr("device memory")
+        L.append(f"  peak bytes in use: "
+                 f"{_fmt_bytes(rep['hbm'].get('peak_bytes_in_use'))} "
+                 f"({rep['hbm'].get('samples')} samples)")
+    if rep.get("bench"):
+        hdr("bench rows")
+        for r in rep["bench"]:
+            bits = [str(r.get("model", "?")), str(r.get("mode", ""))]
+            for k in ("images_per_sec", "tokens_per_sec", "mfu"):
+                if _num(r.get(k)):
+                    bits.append(f"{k}={r[k]}")
+            L.append("  " + "  ".join(b for b in bits if b))
+    L.append("")
+    return "\n".join(L)
+
+
+def report_file(jsonl_path, json_out=None, chrome_out=None, out=print):
+    """Load + aggregate + render; optionally write JSON / Chrome trace.
+    The implementation behind `sparknet report`."""
+    events, bad = load_events(jsonl_path)
+    rep = aggregate(events)
+    if bad:
+        rep["malformed_lines"] = bad
+    out(render(rep))
+    if json_out:
+        with open(json_out, "w") as f:
+            json.dump(rep, f, indent=1, default=str)
+        out(f"wrote {json_out}")
+    if chrome_out:
+        from .trace import export_chrome
+        spans = [e for e in events if e.get("event") == "span"]
+        export_chrome(chrome_out, spans)
+        out(f"wrote {chrome_out} ({len(spans)} spans)")
+    return rep

@@ -1,0 +1,165 @@
+"""Span tracer: nested wall-clock spans over the JSONL metrics stream.
+
+Each completed span emits one ``span`` event (name, start/duration in ms
+relative to the tracer epoch, nesting depth, parent span name, thread id,
+plus caller attrs). Spans nest per-thread via a thread-local stack, so a
+prefetch worker's spans interleave correctly with the training loop's.
+The tracer also keeps a bounded in-memory buffer of completed spans for
+Chrome ``trace_event`` export — load the file in chrome://tracing or
+Perfetto next to a jax.profiler device trace.
+
+``JaxProfiler`` packages the steady-state one-block device-trace toggle
+that used to live inline in cli.cmd_train.
+"""
+
+import json
+import os
+import threading
+import time
+from contextlib import contextmanager
+
+
+class Tracer:
+    """Nested spans over a MetricsLogger sink (sink=None -> spans still
+    nest and buffer for Chrome export, nothing hits the JSONL)."""
+
+    def __init__(self, sink=None, max_buffer=100_000):
+        self.sink = sink
+        self.t0 = time.perf_counter()
+        self._tls = threading.local()
+        self._lock = threading.Lock()
+        self._buf = []
+        self.dropped = 0
+        self.max_buffer = max_buffer
+
+    def _stack(self):
+        st = getattr(self._tls, "stack", None)
+        if st is None:
+            st = self._tls.stack = []
+        return st
+
+    @contextmanager
+    def span(self, name, **attrs):
+        """Context manager timing one phase; yields the attrs dict so the
+        body can attach fields discovered mid-span (attrs["n"] = ...)."""
+        st = self._stack()
+        parent = st[-1] if st else None
+        st.append(name)
+        start = time.perf_counter() - self.t0
+        try:
+            yield attrs
+        finally:
+            st.pop()
+            end = time.perf_counter() - self.t0
+            rec = {"name": name, "start_ms": round(start * 1e3, 3),
+                   "dur_ms": round((end - start) * 1e3, 3),
+                   "depth": len(st), "parent": parent,
+                   "tid": threading.get_ident()}
+            rec.update(attrs)
+            self._record(rec)
+
+    def instant(self, name, **attrs):
+        """A zero-duration mark (Chrome 'instant' event)."""
+        rec = {"name": name,
+               "start_ms": round((time.perf_counter() - self.t0) * 1e3, 3),
+               "dur_ms": 0.0, "depth": len(self._stack()),
+               "parent": self._stack()[-1] if self._stack() else None,
+               "tid": threading.get_ident()}
+        rec.update(attrs)
+        self._record(rec)
+
+    def _record(self, rec):
+        with self._lock:
+            if len(self._buf) < self.max_buffer:
+                self._buf.append(rec)
+            else:
+                self.dropped += 1
+        if self.sink is not None:
+            self.sink.log("span", **rec)
+
+    def spans(self):
+        with self._lock:
+            return list(self._buf)
+
+    def export_chrome(self, path):
+        """Write buffered spans as a Chrome trace_event JSON file."""
+        return export_chrome(path, self.spans(), dropped=self.dropped)
+
+
+def chrome_from_spans(spans, pid=None):
+    """span records (start_ms/dur_ms/name/tid + attrs) -> trace_event
+    'X' (complete) events, timestamps in microseconds."""
+    pid = pid if pid is not None else os.getpid()
+    skip = {"name", "start_ms", "dur_ms", "tid", "depth", "parent",
+            "event", "t", "run"}
+    evs = []
+    for s in spans:
+        args = {k: v for k, v in s.items() if k not in skip}
+        if s.get("parent"):
+            args["parent"] = s["parent"]
+        evs.append({"name": str(s.get("name", "?")),
+                    "ph": "X" if s.get("dur_ms", 0) else "i",
+                    "ts": round(float(s.get("start_ms", 0.0)) * 1e3, 1),
+                    "dur": round(float(s.get("dur_ms", 0.0)) * 1e3, 1),
+                    "pid": pid, "tid": int(s.get("tid", 0)) % (1 << 31),
+                    "cat": "span", "args": args})
+    return evs
+
+
+def export_chrome(path, spans, pid=None, dropped=0):
+    """Write span records to ``path`` in Chrome trace_event format."""
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    doc = {"traceEvents": chrome_from_spans(spans, pid=pid),
+           "displayTimeUnit": "ms"}
+    if dropped:
+        doc["otherData"] = {"dropped_spans": dropped}
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    return path
+
+
+class JaxProfiler:
+    """The steady-state one-block jax.profiler toggle (formerly inline in
+    cli.cmd_train): skip the compile-heavy first block of THIS process
+    (fresh start or snapshot resume alike) so the trace shows steady-state
+    device time (XLA ops, HBM, infeed); runs short enough to have only one
+    block trace that block."""
+
+    def __init__(self, logdir, log=print, block_iters=100):
+        self.logdir = logdir
+        self.log = log or (lambda *a: None)
+        self.block_iters = block_iters
+        self.active = False
+        self.done = False
+
+    def maybe_start(self, blocks_done, iters_remaining):
+        if not self.logdir or self.done or self.active:
+            return False
+        if blocks_done >= 1 or iters_remaining <= self.block_iters:
+            import jax
+            jax.profiler.start_trace(self.logdir)
+            self.active = True
+        return self.active
+
+    def maybe_stop(self):
+        if not self.active:
+            return
+        import jax
+        jax.profiler.stop_trace()
+        self.active = False
+        self.done = True
+        self.log(f"Wrote profiler trace to {self.logdir} "
+                 "(view with tensorboard or xprof)")
+
+    def abort(self):
+        """Flush the trace of a block that raised — it's the one most
+        worth looking at."""
+        if self.active:
+            import jax
+            try:
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+            self.active = False

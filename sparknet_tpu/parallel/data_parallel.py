@@ -25,6 +25,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from ..solver.solver import Solver
 from .mesh import DATA_AXIS
 from . import context
+from .compat import shard_map
 
 
 def shard_batch(batch, mesh, axis=DATA_AXIS, batch_dim=0, seq_axis=None,
@@ -242,7 +243,7 @@ class DataParallelSolver(Solver):
         bspec = _batch_specs(batch_example, axis,
                              batch_dim=0 if iter_size == 1 else 1)
         with context.axis_context(data=axis):
-            sharded = jax.shard_map(
+            sharded = shard_map(
                 step, mesh=self.mesh,
                 in_specs=(P(), P(), P(), bspec, P(), P()),
                 out_specs=(P(), P(), P(), P()),
@@ -252,6 +253,23 @@ class DataParallelSolver(Solver):
     def _build_train_step(self):
         # built lazily on first batch (need shapes for specs)
         return None
+
+    def _register_comms(self, cm):
+        """Per-step DP sync: one grads+state pmean over the data axis —
+        the P2PSync replacement, costed with the same ring model as
+        bench.py's projection."""
+        from ..obs.comms import (tree_bytes, ring_allreduce_bytes,
+                                 broadcast_collect_bytes)
+        super()._register_comms(cm)
+        n = self.mesh.shape[self.axis]
+        gb = tree_bytes(self.params)
+        sb = tree_bytes(self.state)
+        cm.set_topology(axes=dict(self.mesh.shape))
+        cm.register(
+            "allreduce_grads", ring_allreduce_bytes(gb + sb, n),
+            axis=self.axis,
+            note="pmean(grads)+pmean(state) per step, ring model per chip",
+            paper_broadcast_collect_bytes=broadcast_collect_bytes(gb, n))
 
     def train_step(self, batch):
         batch = {k: np.asarray(v) for k, v in batch.items()}
@@ -269,7 +287,9 @@ class DataParallelSolver(Solver):
             self.params, self.state, self.history, dev_batch,
             jnp.asarray(self.iter, jnp.int32), key)
         self.iter += 1
-        self._timing["train_step"] += _t.perf_counter() - t0
+        host_s = _t.perf_counter() - t0
+        self._timing["train_step"] += host_s
+        self._obs_step(host_s, loss, batch)
         return loss
 
     def _build_eval_step(self):
@@ -293,7 +313,7 @@ class DataParallelSolver(Solver):
             if key not in compiled:
                 bspec = {k: (P(axis) if v.ndim else P())
                          for k, v in batch.items()}
-                compiled[key] = jax.jit(jax.shard_map(
+                compiled[key] = jax.jit(shard_map(
                     ev, mesh=self.mesh, in_specs=(P(), P(), bspec),
                     out_specs=P(), check_vma=False))
             dev = shard_batch(batch, self.mesh, self.axis)
@@ -341,12 +361,13 @@ class LocalSGDSolver(Solver):
         axis, tau = self.axis, self.tau
         unroll = self.unroll
         if unroll is None:
-            # 0 = fully unroll regardless of tau. unroll=tau would seem
-            # equivalent but lowers tau==1 through the While path (jax
-            # excludes unroll==1 from its full-unroll shortcut), which
-            # XLA:CPU pessimizes ~10x like any conv-in-loop
-            unroll = 0 if all(d.platform == "cpu"
-                              for d in self.mesh.devices.flat) else 1
+            # True = fully unroll regardless of tau (works on every jax
+            # vintage; integer 0 is rejected by older lax.scan). unroll=tau
+            # would seem equivalent but lowers tau==1 through the While
+            # path (jax excludes unroll==1 from its full-unroll shortcut),
+            # which XLA:CPU pessimizes ~10x like any conv-in-loop
+            unroll = True if all(d.platform == "cpu"
+                                 for d in self.mesh.devices.flat) else 1
         average_history = self.average_history
         loss_fn = self._wrapped_loss(net)
 
@@ -388,26 +409,49 @@ class LocalSGDSolver(Solver):
 
         bspec = _batch_specs(batch_example, axis, batch_dim=1)
         with context.axis_context(data=axis):
-            sharded = jax.shard_map(
+            sharded = shard_map(
                 round_fn, mesh=self.mesh,
                 in_specs=(P(), P(), P(), bspec, P(), P()),
                 out_specs=(P(), P(), P(), P()),
                 check_vma=False)
             return jax.jit(sharded, donate_argnums=(0, 1, 2))
 
+    def _register_comms(self, cm):
+        """The SparkNet tradeoff itself: ONE param-sized averaging pmean
+        per tau-step round (vs. DP's per-step grad allreduce)."""
+        from ..obs.comms import (tree_bytes, ring_allreduce_bytes,
+                                 broadcast_collect_bytes)
+        super()._register_comms(cm)
+        n = self.mesh.shape[self.axis]
+        pb = tree_bytes(self.params) + tree_bytes(self.state)
+        if self.average_history:
+            pb += tree_bytes(self.history)
+        cm.set_topology(axes=dict(self.mesh.shape), tau=self.tau)
+        cm.register(
+            "param_average", ring_allreduce_bytes(pb, n), axis=self.axis,
+            steps_per_round=self.tau,
+            note="one weight-averaging pmean per tau-step round "
+                 "(the paper's broadcast+collect)",
+            paper_broadcast_collect_bytes=broadcast_collect_bytes(pb, n))
+
     def train_round(self, batches):
         """One outer round. ``batches``: dict of arrays with leading axes
         (tau, global_batch, ...) — tau steps, batch dim sharded across
         workers. Returns mean per-worker loss over the round."""
+        import time as _t
         batches = {k: np.asarray(v) for k, v in batches.items()}
         if self._jit_round is None:
             self._jit_round = self._build_round(batches)
         self.rng, key = jax.random.split(self.rng)
+        t0 = _t.perf_counter()
         dev = shard_batch(batches, self.mesh, self.axis, batch_dim=1)
         self.params, self.state, self.history, loss = self._jit_round(
             self.params, self.state, self.history, dev,
             jnp.asarray(self.iter, jnp.int32), key)
         self.iter += self.tau
+        host_s = _t.perf_counter() - t0
+        self._timing["train_round"] += host_s
+        self._obs_step(host_s, loss, batches)
         return loss
 
     def run(self, num_rounds, batch_fn, test_data_fn=None, test_every=10):

@@ -48,6 +48,7 @@ from ..solver.solver import Solver
 from .data_parallel import _rebatch, _batch_specs, shard_batch, \
     check_global_feed, check_seq_shardable_losses, place_tree
 from . import context
+from .compat import shard_map, axis_size
 
 
 class ExpertParallelSolver(Solver):
@@ -163,7 +164,7 @@ class ExpertParallelSolver(Solver):
         def step(params, state, history, batch, it, rng):
             flat_idx = jax.lax.axis_index(da)
             for a in ([sa] if sa else []) + [ea]:
-                flat_idx = flat_idx * jax.lax.axis_size(a) \
+                flat_idx = flat_idx * axis_size(a) \
                     + jax.lax.axis_index(a)
             rng = jax.random.fold_in(rng, flat_idx)
 
@@ -180,7 +181,7 @@ class ExpertParallelSolver(Solver):
 
         bspec = self._batch_spec(batch_example)
         pspec, hspec = self._param_specs, self._history_specs
-        sharded = jax.shard_map(
+        sharded = shard_map(
             step, mesh=self.mesh,
             in_specs=(pspec, P(), hspec, bspec, P(), P()),
             out_specs=(pspec, P(), hspec, P(), P()),
@@ -189,6 +190,51 @@ class ExpertParallelSolver(Solver):
 
     def _build_train_step(self):
         return None              # built lazily on the first batch
+
+    def _register_comms(self, cm):
+        """Three traffic classes per step (module docstring): replicated
+        params pmean over ALL axes; expert-sharded params pmean over the
+        non-expert axes only; and the MoE dispatch/combine all_to_all
+        pairs over the expert axis (fwd + bwd), costed from the local
+        activation shapes."""
+        from ..obs.comms import (tree_bytes, ring_allreduce_bytes,
+                                 all_to_all_bytes)
+        super()._register_comms(cm)
+        ep = self.ep
+        n_other = max(1, self.mesh.size // ep)
+        eb = rb = 0
+        for ln, blobs in self.params.items():
+            flags = self._expert_flags.get(ln) or [False] * len(blobs)
+            for b, is_expert in zip(blobs, flags):
+                if is_expert:
+                    eb += int(b.nbytes)
+                else:
+                    rb += int(b.nbytes)
+        rb += tree_bytes(self.state)
+        cm.set_topology(axes=dict(self.mesh.shape))
+        cm.register("allreduce_dense", ring_allreduce_bytes(rb, self.mesh.size),
+                    axis="all",
+                    note="replicated-param grads + state pmean per step")
+        if eb:
+            cm.register("allreduce_expert", ring_allreduce_bytes(eb, n_other),
+                        axis=self.data_axis,
+                        note="expert-sharded grads pmean over non-expert "
+                             "axes (global expert bytes)")
+        a2a = 0
+        itemsize = np.dtype(self.net.compute_dtype
+                            or self.net.dtype).itemsize
+        for lp, impl, bottoms, _ in self.local_net.layers:
+            if lp.type == "MoE" and getattr(impl, "expert_parallel", False):
+                act = 1
+                for d in self.local_net.blob_shapes[bottoms[0]]:
+                    act *= int(d)
+                # dispatch + combine, forward and backward: 4 all_to_alls
+                # of the (capacity-padded ~ input-sized) token buffer
+                a2a += 4 * all_to_all_bytes(act * itemsize, ep)
+        if a2a:
+            cm.register("moe_all_to_all", a2a, axis=self.expert_axis,
+                        note="token dispatch/combine fwd+bwd per step "
+                             "(analytic, from local activation shapes)")
 
     def _shard(self, batch):
         return shard_batch(batch, self.mesh,
@@ -214,7 +260,9 @@ class ExpertParallelSolver(Solver):
                 self.params, self.state, self.history, dev,
                 self._it_dev, key)
         self.iter += 1
-        self._timing["train_step"] += _time.perf_counter() - t0
+        host_s = _time.perf_counter() - t0
+        self._timing["train_step"] += host_s
+        self._obs_step(host_s, loss, batch)
         return loss
 
     def _build_eval_step(self):
@@ -244,7 +292,7 @@ class ExpertParallelSolver(Solver):
             with self._axes_context():
                 if key not in compiled:
                     bspec = self._batch_spec(batch)
-                    compiled[key] = jax.jit(jax.shard_map(
+                    compiled[key] = jax.jit(shard_map(
                         ev, mesh=self.mesh,
                         in_specs=(self._param_specs, P(), bspec),
                         out_specs=P(), check_vma=False))

@@ -27,6 +27,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
+from .compat import shard_map
 
 
 def gpipe(block_fn, local_params, microbatches, axis):
@@ -100,7 +101,7 @@ def pipeline_apply(block_fn, stacked_params, x, mesh, num_microbatches,
         return gpipe(block_fn, params, xs, axis)
 
     pspec = jax.tree_util.tree_map(lambda _: P(axis), stacked_params)
-    out = jax.shard_map(
+    out = shard_map(
         inner, mesh=mesh,
         in_specs=(pspec, P()), out_specs=P(), check_vma=False,
     )(stacked_params, mb)

@@ -70,10 +70,19 @@ class PipelineLMSolver:
                 "multi-process PipelineLMSolver requires an explicit "
                 "SolverParameter.random_seed: hosts must agree on param "
                 "init and rng streams")
+        self._own_metrics = isinstance(metrics, str)
         if isinstance(metrics, str):
             from ..utils.metrics import MetricsLogger
             metrics = MetricsLogger(metrics)
         self.metrics = metrics
+        from ..obs import Tracer
+        self.tracer = Tracer(self.metrics)
+        self.stepstats = self.comms = None
+        self._comms_registered = False
+        if self.metrics is not None:
+            from ..obs import StepAccounting, CommsMeter
+            self.stepstats = StepAccounting(self.metrics)
+            self.comms = CommsMeter(self.metrics)
         self.mesh = mesh if mesh is not None else make_mesh({axis: -1})
         self.axis = axis
         S = self.mesh.shape[axis]
@@ -188,7 +197,61 @@ class PipelineLMSolver:
             return None
         return float(self._last_loss)
 
+    def _register_comms(self, cm):
+        """GPipe stage traffic: every microbatch activation crosses each
+        stage boundary once forward (ppermute) and its gradient once
+        backward — per chip that is M microbatch activations out per
+        direction per step."""
+        from ..obs.comms import tree_bytes
+        S = self.mesh.shape[self.axis]
+        mb = self.batch_size // self.num_microbatches
+        d_model = self.suffix.feed_shapes()["x"][2]
+        act = mb * self.seq_len * d_model * 4       # f32 carrier
+        cm.set_topology(strategy=type(self).__name__,
+                        n_devices=self.mesh.size,
+                        axes=dict(self.mesh.shape),
+                        param_bytes=tree_bytes(self.params))
+        if S > 1:
+            cm.register("pipeline_ppermute",
+                        2 * self.num_microbatches * act, axis=self.axis,
+                        note="microbatch activations fwd + grads bwd, "
+                             "per chip per step")
+
+    def _obs_step(self, host_s, result, batch):
+        if self.stepstats is None:
+            return
+        if not self._comms_registered:
+            self._comms_registered = True
+            try:
+                self._register_comms(self.comms)
+            except Exception as e:
+                self.log(f"comms registration failed: {e!r}")
+        from ..obs.comms import tree_bytes
+        it = self.iter - 1
+        self.comms.add_h2d(tree_bytes(batch))
+        self.comms.tick(it)
+        self.stepstats.observe(it, host_s, result=result,
+                               jit_fn=self._jit_train, batch=batch)
+
+    def close(self):
+        """Flush observability summaries; close an owned metrics stream.
+        Mirrors Solver.close() so drivers stay solver-agnostic."""
+        if self.stepstats is not None:
+            try:
+                self.stepstats.flush(self.iter)
+            finally:
+                self.stepstats = None
+        if self.comms is not None:
+            try:
+                self.comms.flush(self.iter - 1)
+            finally:
+                self.comms = None
+        if self._own_metrics and self.metrics is not None:
+            self.metrics.close()
+            self.metrics = None
+
     def train_step(self, batch):
+        import time
         if self._jit_train is None:
             self._jit_train = self._build_train_step()
         if jax.process_count() > 1 and not getattr(self, "_feed_checked",
@@ -198,12 +261,14 @@ class PipelineLMSolver:
         self.rng, key = jax.random.split(self.rng)
         if self._it_dev is None:
             self._it_dev = jnp.asarray(self.iter, jnp.int32)
+        t0 = time.perf_counter()
         batch = place_tree({k: np.asarray(v) for k, v in batch.items()},
                            {k: P() for k in batch}, self.mesh)
         self.params, self.history, loss, self._it_dev = self._jit_train(
             self.params, self.history, batch, self._it_dev, key)
         self.iter += 1
         self._last_loss = loss
+        self._obs_step(time.perf_counter() - t0, loss, batch)
         return loss
 
     def step(self, num_iters, data_iter):

@@ -28,6 +28,7 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
+from .compat import shard_map
 
 
 def _stable_block_update(o, m, l, s, v):
@@ -127,6 +128,19 @@ def dense_attention(q, k, v, causal=False, scale=None):
                       v.astype(jnp.float32)).astype(q.dtype)
 
 
+def ring_attention_comm_bytes(block_shape, n, itemsize=4):
+    """Per-chip bytes one ring_attention forward moves over the seq axis:
+    the K and V blocks (each ``block_shape``, the local shard) are
+    ppermuted ``n`` times around the ring (the final rotation returns
+    blocks home; XLA may elide it, so this is a slight upper bound).
+    Used by the obs comms meter — the traffic itself runs inside the
+    compiled step and can't be counted from the host."""
+    total = 1
+    for d in block_shape:
+        total *= int(d)
+    return int(2 * int(n) * total * itemsize)
+
+
 def sequence_sharded_apply(fn, mesh, seq_axis="seq", batch_args=(),
                            seq_dim=1):
     """Wrap ``fn(*arrays)`` so its array args are sharded along ``seq_dim``
@@ -141,9 +155,9 @@ def sequence_sharded_apply(fn, mesh, seq_axis="seq", batch_args=(),
     @functools.wraps(fn)
     def wrapped(*args):
         with context.axis_context(seq=seq_axis):
-            inner = jax.shard_map(fn, mesh=mesh,
-                                  in_specs=tuple(sp for _ in args),
-                                  out_specs=sp, check_vma=False)
+            inner = shard_map(fn, mesh=mesh,
+                              in_specs=tuple(sp for _ in args),
+                              out_specs=sp, check_vma=False)
             return inner(*args)
 
     return wrapped

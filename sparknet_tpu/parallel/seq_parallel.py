@@ -29,6 +29,7 @@ from ..solver.solver import Solver
 from .data_parallel import _rebatch, _batch_specs, shard_batch, \
     check_global_feed, check_seq_shardable_losses
 from . import context
+from .compat import shard_map, axis_size
 
 
 class SeqParallelSolver(Solver):
@@ -82,7 +83,7 @@ class SeqParallelSolver(Solver):
 
         def step(params, state, history, batch, it, rng):
             # distinct rng stream per shard (dropout etc.)
-            flat_idx = jax.lax.axis_index(da) * jax.lax.axis_size(sa) \
+            flat_idx = jax.lax.axis_index(da) * axis_size(sa) \
                 + jax.lax.axis_index(sa)
             rng = jax.random.fold_in(rng, flat_idx)
 
@@ -98,7 +99,7 @@ class SeqParallelSolver(Solver):
             return params, state, history, loss, it + 1
 
         bspec = self._batch_spec(batch_example)
-        sharded = jax.shard_map(
+        sharded = shard_map(
             step, mesh=self.mesh,
             in_specs=(P(), P(), P(), bspec, P(), P()),
             out_specs=(P(), P(), P(), P(), P()),
@@ -107,6 +108,38 @@ class SeqParallelSolver(Solver):
 
     def _build_train_step(self):
         return None              # built lazily on the first batch
+
+    def _register_comms(self, cm):
+        """Grads/state pmean over both axes (costed as one ring over the
+        full mesh), plus ring attention's neighbor ppermute traffic —
+        each attention layer rotates its local K/V shard around the seq
+        ring once per step (forward; backward re-runs the ring, x2)."""
+        from ..obs.comms import tree_bytes, ring_allreduce_bytes
+        from .ring import ring_attention_comm_bytes
+        super()._register_comms(cm)
+        nd = self.mesh.size
+        sp = self.mesh.shape[self.seq_axis]
+        gb = tree_bytes(self.params) + tree_bytes(self.state)
+        cm.set_topology(axes=dict(self.mesh.shape))
+        cm.register("allreduce_grads", ring_allreduce_bytes(gb, nd),
+                    axis=f"{self.data_axis}x{self.seq_axis}",
+                    note="pmean(grads)+pmean(state) per step")
+        if sp > 1:
+            itemsize = np.dtype(self.net.compute_dtype
+                                or self.net.dtype).itemsize
+            ring_b = 0
+            for lp, impl, bottoms, _ in self.local_net.layers:
+                if getattr(impl, "ring", False):
+                    b, s_local = self.local_net.blob_shapes[bottoms[0]][:2]
+                    block = (b, s_local, getattr(impl, "inner", 0))
+                    ring_b += ring_attention_comm_bytes(block, sp,
+                                                        itemsize=itemsize)
+            if ring_b:
+                # backward replays the K/V rotation: ~2x forward traffic
+                cm.register("ring_attention_ppermute", 2 * ring_b,
+                            axis=self.seq_axis,
+                            note="K/V block rotation, fwd+bwd, per chip "
+                                 "(analytic, from local activation shapes)")
 
     def _shard(self, batch):
         return shard_batch(batch, self.mesh, self.data_axis,
@@ -131,7 +164,9 @@ class SeqParallelSolver(Solver):
                 self.params, self.state, self.history, dev,
                 self._it_dev, key)
         self.iter += 1
-        self._timing["train_step"] += _time.perf_counter() - t0
+        host_s = _time.perf_counter() - t0
+        self._timing["train_step"] += host_s
+        self._obs_step(host_s, loss, batch)
         return loss
 
     def _build_eval_step(self):
@@ -156,7 +191,7 @@ class SeqParallelSolver(Solver):
             with self._axes_context():
                 if key not in compiled:
                     bspec = self._batch_spec(batch)
-                    compiled[key] = jax.jit(jax.shard_map(
+                    compiled[key] = jax.jit(shard_map(
                         ev, mesh=self.mesh, in_specs=(P(), P(), bspec),
                         out_specs=P(), check_vma=False))
                 return compiled[key](params, state, self._shard(batch))

@@ -69,16 +69,27 @@ class Solver:
 
     def __init__(self, solver_param, net_param=None, feed_shapes=None,
                  test_feed_shapes=None, base_dir="", dtype=jnp.float32,
-                 log_fn=print, metrics=None, compute_dtype=None):
+                 log_fn=print, metrics=None, compute_dtype=None,
+                 tracer=None):
         self.param = solver_param
         self.log = log_fn or (lambda *a: None)
         # structured observability hooks, armed by default from the CLI:
-        # a JSONL MetricsLogger (or path) and an optional Watchdog that
-        # step() beats once per iteration (SURVEY.md section 5 gaps)
+        # a JSONL MetricsLogger (or path), a span Tracer over it, step
+        # accounting + comms metering (sparknet_tpu.obs), and an optional
+        # Watchdog that step() beats once per iteration
+        self._own_metrics = isinstance(metrics, str)
         if isinstance(metrics, str):
             from ..utils.metrics import MetricsLogger
             metrics = MetricsLogger(metrics)
         self.metrics = metrics
+        from ..obs import Tracer
+        self.tracer = tracer if tracer is not None else Tracer(self.metrics)
+        self.stepstats = self.comms = None
+        self._comms_registered = False
+        if self.metrics is not None:
+            from ..obs import StepAccounting, CommsMeter
+            self.stepstats = StepAccounting(self.metrics)
+            self.comms = CommsMeter(self.metrics)
         self.watchdog = None
         train_np, test_np = resolve_nets(solver_param, base_dir, net_param)
         # NetState from the solver (reference solver.cpp InitTrainNet /
@@ -314,8 +325,69 @@ class Solver:
     def arm_watchdog(self, stall_seconds=300.0, **kw):
         """Start a stall/NaN watchdog that step() beats each iteration."""
         from ..utils.watchdog import Watchdog
+        kw.setdefault("metrics", self.metrics)
         self.watchdog = Watchdog(stall_seconds=stall_seconds, **kw).start()
         return self.watchdog
+
+    # -- observability (sparknet_tpu.obs) ----------------------------------
+    def _register_comms(self, cm):
+        """Declare this solver's per-round collective volume with the
+        CommsMeter — overridden by sharded solvers; the base solver only
+        has host->device feed traffic."""
+        from ..obs.comms import tree_bytes
+        cm.set_topology(strategy=type(self).__name__,
+                        n_devices=jax.device_count(),
+                        param_bytes=tree_bytes(self.params))
+
+    def _obs_step(self, host_s, result, batch):
+        """Per-step hook called by every train_step/train_round variant:
+        h2d byte counting, comms emission, step accounting. No-op (one
+        attribute test) when metrics is off."""
+        if self.stepstats is None:
+            return
+        if not self._comms_registered:
+            self._comms_registered = True
+            try:
+                self._register_comms(self.comms)
+            except Exception as e:      # accounting must never kill a run
+                self.log(f"comms registration failed: {e!r}")
+        it = self.iter - 1
+        from ..obs.comms import tree_bytes
+        self.comms.add_h2d(tree_bytes(batch))
+        self.comms.tick(it)
+        jit_fn = self._jit_train if self._jit_train is not None \
+            else getattr(self, "_jit_round", None)   # LocalSGDSolver
+        self.stepstats.observe(it, host_s, result=result,
+                               jit_fn=jit_fn, batch=batch)
+
+    def close(self):
+        """Teardown: stop the watchdog thread (a leaked monitor thread
+        keeps pytest and short-lived drivers alive), flush step/comms
+        summaries, and close an internally-owned metrics stream.
+        Idempotent; training can NOT continue afterwards with metrics."""
+        if self.watchdog is not None:
+            self.watchdog.stop()
+            self.watchdog = None
+        if self.stepstats is not None:
+            try:
+                self.stepstats.flush(self.iter)
+            finally:
+                self.stepstats = None
+        if self.comms is not None:
+            try:
+                self.comms.flush(self.iter - 1)
+            finally:
+                self.comms = None
+        if self._own_metrics and self.metrics is not None:
+            self.metrics.close()
+            self.metrics = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
 
     # -- public API --------------------------------------------------------
     def check_batch(self, batch, leading=(), split_across_hosts=True):
@@ -372,7 +444,9 @@ class Solver:
             self._jit_train(self.params, self.state, self.history, batch,
                             self._it_dev, key)
         self.iter += 1
-        self._timing["train_step"] += time.perf_counter() - t0
+        host_s = time.perf_counter() - t0
+        self._timing["train_step"] += host_s
+        self._obs_step(host_s, loss, batch)
         return loss
 
     def step(self, num_iters, data_iter, test_data_fn=None):
@@ -451,6 +525,10 @@ class Solver:
     def test(self, data_iter, num_iters=None):
         """Average the TEST net's output blobs over test_iter batches
         (reference solver.cpp TestAndStoreResult :414-444)."""
+        with self.tracer.span("test", iter=self.iter):
+            return self._test(data_iter, num_iters)
+
+    def _test(self, data_iter, num_iters=None):
         if self._jit_eval is None:
             self._jit_eval = self._build_eval_step()
         n = num_iters or (int(self.param.test_iter[0])
@@ -484,6 +562,10 @@ class Solver:
     def snapshot(self, prefix=None, format=None):
         """Write weights + solver state. format: "binaryproto" (default) |
         "hdf5", or taken from SolverParameter.snapshot_format (HDF5=0)."""
+        with self.tracer.span("snapshot", iter=self.iter):
+            return self._snapshot(prefix, format)
+
+    def _snapshot(self, prefix=None, format=None):
         from . import hdf5_io
         prefix = prefix or self.param.snapshot_prefix
         d = os.path.dirname(prefix)

@@ -1,10 +1,44 @@
 """Structured metrics — what the reference lacked (SURVEY.md section 5:
 "No structured metrics system"); loss/accuracy went to glog + ad-hoc
-timing logs (CifarApp.scala:43-52). One JSONL stream, one line per event."""
+timing logs (CifarApp.scala:43-52). One JSONL stream, one line per event.
+
+This is the backend of the sparknet_tpu.obs subsystem: the span tracer,
+step accounting, comms meter, watchdog, and prefetch gauges all write
+through one MetricsLogger, so a single JSONL file carries the whole run
+and `sparknet report` can reconstruct it. Consequences: writes are
+thread-safe (the tracer and watchdog log from their own threads), the
+logger is a context manager, and field encoding must never crash a run —
+numpy arrays, dtypes, Paths, and anything else non-JSON go through a
+safe default encoder instead of raising mid-training.
+"""
 
 import json
 import sys
+import threading
 import time
+
+
+def json_default(o):
+    """Best-effort JSON encoding for arbitrary metric field values."""
+    if getattr(o, "ndim", None) == 0 and hasattr(o, "item"):
+        try:
+            return o.item()            # numpy/jax scalar
+        except Exception:
+            pass
+    if hasattr(o, "tolist"):           # ndarray / jax array
+        try:
+            if getattr(o, "size", 0) <= 64:
+                return o.tolist()
+            return {"shape": list(getattr(o, "shape", ())),
+                    "dtype": str(getattr(o, "dtype", "?")),
+                    "summary": "array too large; elided"}
+        except Exception:
+            pass
+    if isinstance(o, (set, frozenset)):
+        return sorted(str(x) for x in o)
+    if isinstance(o, bytes):
+        return o.decode("utf-8", "replace")
+    return str(o)                      # dtypes, Paths, enums, ...
 
 
 class MetricsLogger:
@@ -13,18 +47,43 @@ class MetricsLogger:
         self._own = path is not None
         self.run_id = run_id
         self.t0 = time.time()
+        self._lock = threading.Lock()
+        self._closed = False
 
     def log(self, event, **fields):
-        rec = {"event": event, "t": round(time.time() - self.t0, 3)}
+        rec = {"event": event, "t": round(time.time() - self.t0, 4)}
         if self.run_id:
             rec["run"] = self.run_id
         for k, v in fields.items():
-            if hasattr(v, "item"):      # numpy/jax scalar
-                v = v.item()
+            if hasattr(v, "item") and getattr(v, "ndim", 0) == 0:
+                try:
+                    v = v.item()       # numpy/jax scalar fast path
+                except Exception:
+                    pass
             rec[k] = v
-        self.f.write(json.dumps(rec) + "\n")
-        self.f.flush()
+        try:
+            line = json.dumps(rec, default=json_default)
+        except (TypeError, ValueError) as e:
+            # circular refs etc. — record that the event existed
+            line = json.dumps({"event": event, "t": rec["t"],
+                               "encode_error": str(e)})
+        with self._lock:
+            if self._closed:
+                return
+            self.f.write(line + "\n")
+            self.f.flush()
 
     def close(self):
-        if self._own:
-            self.f.close()
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            if self._own:
+                self.f.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

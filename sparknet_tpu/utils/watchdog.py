@@ -11,7 +11,9 @@ Also detects non-finite losses (the "model blew up" failure class) so long
 unattended runs stop burning chips on NaNs.
 """
 
+import math
 import os
+import sys
 import threading
 import time
 
@@ -23,16 +25,21 @@ class Watchdog:
     detection (then re-arms); on_nan(loss) from beat(). Defaults: log via
     print; kill_on_stall escalates to os._exit so an external supervisor
     (k8s, xmanager) can reschedule from the last snapshot.
+
+    With ``metrics`` (a utils.metrics.MetricsLogger), every stall/NaN
+    also lands in the run's JSONL as a ``watchdog`` event, so `sparknet
+    report` surfaces failure barks next to the loss curve they garbled.
     """
 
     def __init__(self, stall_seconds=300.0, on_stall=None, on_nan=None,
-                 kill_on_stall=False, poll_seconds=None):
+                 kill_on_stall=False, poll_seconds=None, metrics=None):
         self.stall_seconds = float(stall_seconds)
         self.on_stall = on_stall or (lambda dt: print(
             f"[watchdog] no training step for {dt:.0f}s"))
         self.on_nan = on_nan or (lambda loss: print(
             f"[watchdog] non-finite loss {loss}"))
         self.kill_on_stall = kill_on_stall
+        self.metrics = metrics
         self.poll = poll_seconds or min(10.0, self.stall_seconds / 4)
         self._last = time.monotonic()
         self._stop = threading.Event()
@@ -41,7 +48,10 @@ class Watchdog:
         self.nans = 0
 
     def start(self):
+        if self._thread is not None and self._thread.is_alive():
+            return self                     # idempotent: don't leak threads
         self._last = time.monotonic()
+        self._stop.clear()
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="sparknet-watchdog")
         self._thread.start()
@@ -52,8 +62,10 @@ class Watchdog:
         self._last = time.monotonic()
         if loss is not None:
             v = float(loss)
-            if v != v or v in (float("inf"), float("-inf")):
+            if not math.isfinite(v):
                 self.nans += 1
+                if self.metrics is not None:
+                    self.metrics.log("watchdog", kind="nan", loss=v)
                 self.on_nan(v)
 
     def _run(self):
@@ -61,7 +73,14 @@ class Watchdog:
             dt = time.monotonic() - self._last
             if dt > self.stall_seconds:
                 self.stalls += 1
-                self.on_stall(dt)
+                if self.metrics is not None:
+                    self.metrics.log("watchdog", kind="stall",
+                                     elapsed_s=round(dt, 1))
+                try:
+                    self.on_stall(dt)
+                except Exception as e:      # a raising callback must not
+                    print(f"[watchdog] on_stall raised: {e!r}",  # kill the
+                          file=sys.stderr)                # monitor thread
                 if self.kill_on_stall:
                     os._exit(42)
                 self._last = time.monotonic()   # re-arm

@@ -13,6 +13,7 @@ from sparknet_tpu.graph.compiler import CompiledNet, TRAIN
 from sparknet_tpu.parallel import make_mesh, context
 
 from test_layers import make_layer
+from sparknet_tpu.parallel.compat import shard_map
 
 
 def _params(layer, seed=0, scale=0.3):
@@ -106,7 +107,7 @@ def test_moe_expert_parallel_matches_single_device():
         return y
 
     with context.axis_context(expert="expert"):
-        sharded = jax.jit(jax.shard_map(
+        sharded = jax.jit(shard_map(
             fwd, mesh=mesh,
             in_specs=(P(), P("expert"), P("expert"), P("expert"),
                       P("expert"), P()),
@@ -142,7 +143,7 @@ def test_moe_expert_parallel_shards_compute():
         return y
 
     with context.axis_context(expert="expert"):
-        sharded = jax.jit(jax.shard_map(
+        sharded = jax.jit(shard_map(
             fwd, mesh=mesh,
             in_specs=(P(), P("expert"), P("expert"), P("expert"),
                       P("expert"), P(None, "expert")),   # tokens SHARDED

@@ -16,6 +16,7 @@ from sparknet_tpu.parallel.ring import dense_attention
 from sparknet_tpu.proto import Message
 from sparknet_tpu.solver.solver import Solver
 from sparknet_tpu.data.synthetic import class_gaussian_images
+from sparknet_tpu.parallel.compat import shard_map
 
 
 def small_solver_param(**kw):
@@ -180,7 +181,7 @@ class TestRingAttention:
         def f(q, k, v):
             return ring_attention(q, k, v, "seq", causal=causal)
 
-        out = jax.jit(jax.shard_map(
+        out = jax.jit(shard_map(
             f, mesh=mesh,
             in_specs=(P(None, None, "seq"),) * 3,
             out_specs=P(None, None, "seq"), check_vma=False))(q, k, v)
@@ -199,7 +200,7 @@ class TestRingAttention:
         def f(q, k, v):
             return ulysses_attention(q, k, v, "seq", causal=causal)
 
-        out = jax.jit(jax.shard_map(
+        out = jax.jit(shard_map(
             f, mesh=mesh,
             in_specs=(P(None, None, "seq"),) * 3,
             out_specs=P(None, None, "seq"), check_vma=False))(q, k, v)

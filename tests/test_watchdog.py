@@ -1,0 +1,113 @@
+"""Watchdog unit tests — the stall and non-finite-loss paths, fast (no
+real 300 s waits), plus the solver-teardown guarantee that pytest never
+hangs on a leaked monitor thread."""
+
+import io
+import json
+import math
+import time
+
+import numpy as np
+
+from sparknet_tpu.utils.metrics import MetricsLogger
+from sparknet_tpu.utils.watchdog import Watchdog
+
+
+def wait_until(pred, timeout=2.0):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        if pred():
+            return True
+        time.sleep(0.005)
+    return pred()
+
+
+class TestWatchdog:
+    def test_stall_detected_and_rearmed(self):
+        stalls = []
+        wd = Watchdog(stall_seconds=0.05, poll_seconds=0.01,
+                      on_stall=stalls.append).start()
+        try:
+            assert wait_until(lambda: wd.stalls >= 2)
+            assert stalls and stalls[0] >= 0.05
+        finally:
+            wd.stop()
+        assert not wd._thread.is_alive()
+
+    def test_beat_prevents_stall(self):
+        wd = Watchdog(stall_seconds=0.08, poll_seconds=0.01,
+                      on_stall=lambda dt: None).start()
+        try:
+            for _ in range(20):
+                wd.beat(1.0)
+                time.sleep(0.01)
+            assert wd.stalls == 0
+        finally:
+            wd.stop()
+
+    def test_non_finite_loss_paths(self):
+        nans = []
+        wd = Watchdog(on_nan=nans.append)
+        wd.beat(float("nan"))
+        wd.beat(float("inf"))
+        wd.beat(float("-inf"))
+        wd.beat(np.float32("nan"))
+        wd.beat(1.5)                        # finite: no bark
+        assert wd.nans == 4
+        assert len(nans) == 4
+        assert all(not math.isfinite(v) for v in nans)
+
+    def test_raising_on_stall_does_not_kill_monitor(self):
+        def boom(dt):
+            raise RuntimeError("callback bug")
+        wd = Watchdog(stall_seconds=0.03, poll_seconds=0.01,
+                      on_stall=boom).start()
+        try:
+            assert wait_until(lambda: wd.stalls >= 2)
+            assert wd._thread.is_alive()    # survived the raising callback
+        finally:
+            wd.stop()
+
+    def test_start_is_idempotent(self):
+        wd = Watchdog(stall_seconds=10, poll_seconds=0.01).start()
+        t1 = wd._thread
+        assert wd.start()._thread is t1     # no second thread leaked
+        wd.stop()
+        assert not t1.is_alive()
+
+    def test_context_manager(self):
+        with Watchdog(stall_seconds=10, poll_seconds=0.01) as wd:
+            assert wd._thread.is_alive()
+        assert not wd._thread.is_alive()
+
+    def test_metrics_events(self):
+        buf = io.StringIO()
+        ml = MetricsLogger(stream=buf)
+        wd = Watchdog(stall_seconds=0.03, poll_seconds=0.01, metrics=ml,
+                      on_stall=lambda dt: None, on_nan=lambda v: None)
+        wd.start()
+        try:
+            wd.beat(float("nan"))
+            assert wait_until(lambda: wd.stalls >= 1)
+        finally:
+            wd.stop()
+        evs = [json.loads(line) for line in buf.getvalue().splitlines()]
+        kinds = [e["kind"] for e in evs if e["event"] == "watchdog"]
+        assert "nan" in kinds and "stall" in kinds
+
+
+def test_solver_close_stops_watchdog_thread():
+    """The teardown path cmd_train's finally relies on: no daemon thread
+    outlives Solver.close()."""
+    from sparknet_tpu.proto import Message
+    from sparknet_tpu.solver.solver import Solver
+    from tests.test_obs import mlp_net
+    sp = Message("SolverParameter", base_lr=0.1, lr_policy="fixed",
+                 random_seed=0, display=0)
+    s = Solver(sp, net_param=mlp_net(), log_fn=None)
+    wd = s.arm_watchdog(stall_seconds=0.05, poll_seconds=0.01,
+                        on_stall=lambda dt: None)
+    assert wd._thread.is_alive()
+    s.close()
+    assert s.watchdog is None
+    assert not wd._thread.is_alive()
