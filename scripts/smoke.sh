@@ -1,9 +1,15 @@
 #!/usr/bin/env bash
-# Observability smoke test (ISSUE 1 acceptance, CI-runnable on CPU):
-# a 5-step synthetic train with metrics + trace enabled must produce
+# Smoke tests (CI-runnable on CPU):
+# Observability (ISSUE 1): a 5-step synthetic train with metrics + trace
+# enabled must produce
 #   (a) a JSONL with step/span/comms/recompile events (host/device split)
 #   (b) a well-formed Chrome trace_event span file
 #   (c) a `sparknet report` that renders and writes valid JSON.
+# Resilience (ISSUE 2):
+#   (d) SIGTERM mid-run snapshots-then-stops cleanly, and a relaunch with
+#       --resume auto continues the iter counter and loss curve
+#   (e) a chaos-injected NaN rolls back, the run completes to target, and
+#       the report surfaces the recovery events.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 export JAX_PLATFORMS=cpu
@@ -67,5 +73,62 @@ assert rep["comms"]["h2d_bytes_total"] > 0
 assert rep["phases"], "no per-phase breakdown"
 print("report JSON OK")
 EOF
+
+# ------------------------------------------------- kill-and-resume stage ----
+# Start a long run, SIGTERM it mid-run (the preemption notice): the default
+# --sigterm_effect snapshot_stop must write an atomic snapshot and exit 0.
+mkdir -p "$tmp/kr"
+python -m sparknet_tpu train --solver "$tmp/solver.prototxt" \
+    --iterations 200000 --metrics "$tmp/kr1.jsonl" \
+    --snapshot-prefix "$tmp/kr/snap" &
+pid=$!
+sleep 12
+kill -TERM "$pid"
+wait "$pid"
+
+resume_iter=$(python -c "
+import json, sys
+print(json.load(open('$tmp/kr/snap.latest.json'))['latest']['iter'])")
+test "$resume_iter" -gt 0
+echo "preempted at iter $resume_iter with a committed snapshot"
+
+# Relaunch with --resume auto: the iter counter and loss curve continue.
+python -m sparknet_tpu train --solver "$tmp/solver.prototxt" \
+    --iterations $((resume_iter + 100)) --metrics "$tmp/kr2.jsonl" \
+    --snapshot-prefix "$tmp/kr/snap" --resume auto | tee "$tmp/kr2.out"
+grep -q "resume auto: restored iter $resume_iter" "$tmp/kr2.out"
+grep -q "Optimization done, iter=$((resume_iter + 100))" "$tmp/kr2.out"
+
+python - "$tmp" "$resume_iter" <<'EOF'
+import json, sys, os
+tmp, it0 = sys.argv[1], int(sys.argv[2])
+evs = [json.loads(l) for l in open(os.path.join(tmp, "kr2.jsonl"))]
+train = [e for e in evs if e["event"] == "train"]
+assert train, "resumed run logged no train events"
+assert all(e["iter"] >= it0 for e in train), \
+    f"loss curve restarted below iter {it0}"
+print(f"kill/resume OK: curve continued from iter {it0}")
+EOF
+
+# ------------------------------------------------------------ chaos stage ----
+# An injected NaN at step 20 must roll back to last-known-good and the run
+# must still complete to the target iter, with the recovery in the report.
+python -m sparknet_tpu train --solver "$tmp/solver.prototxt" \
+    --iterations 60 --metrics "$tmp/chaos.jsonl" \
+    --snapshot-prefix "$tmp/chaos/snap" \
+    --chaos "nan_step=20,seed=3" --recover 3 | tee "$tmp/chaos.out"
+grep -q "Optimization done, iter=60" "$tmp/chaos.out"
+
+python - "$tmp" <<'EOF'
+import json, sys, os
+evs = [json.loads(l) for l in open(os.path.join(sys.argv[1], "chaos.jsonl"))]
+kinds = {(e["event"], e.get("kind")) for e in evs}
+assert ("chaos", "nan") in kinds, kinds
+assert ("recovery", "rollback") in kinds, kinds
+print("chaos OK: injected NaN, observed rollback, run completed")
+EOF
+# (no -q: grep must drain the pipe, or report dies on BrokenPipeError)
+python -m sparknet_tpu report "$tmp/chaos.jsonl" | grep "resilience" \
+    > /dev/null
 
 echo "SMOKE OK"

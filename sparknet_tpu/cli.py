@@ -131,6 +131,11 @@ def cmd_train(args):
     # spans all land in the same JSONL (see sparknet_tpu.obs)
     metrics = MetricsLogger(args.metrics) if args.metrics else None
     tracer = Tracer(metrics)
+    if args.chaos:
+        # arm BEFORE solver/data construction so sources and the solver
+        # pick the injectors up through active_chaos()
+        from .resilience.chaos import ChaosMonkey, install_chaos
+        install_chaos(ChaosMonkey.parse(args.chaos, metrics=metrics))
     sp = text_format.load(args.solver, "SolverParameter")
     base_dir = _net_base_dir(sp, args.solver)
     if sp.has("snapshot_prefix") and base_dir \
@@ -181,12 +186,29 @@ def cmd_train(args):
             and getattr(test_src, "device_mode", False) else None)
     elif test_src is not None and getattr(test_src, "device_mode", False):
         solver.set_input_transform(None, None, test_fn=test_src.device_fn)
+    solver.snapshot_keep = args.keep or None
+    prefix = args.snapshot_prefix or (
+        sp.snapshot_prefix if sp.has("snapshot_prefix") else None)
     if args.stall_seconds:
         solver.arm_watchdog(stall_seconds=args.stall_seconds)
+    if args.recover:
+        solver.arm_recovery(max_rollbacks=args.recover,
+                            lr_decay=args.recover_lr_decay,
+                            explode_factor=args.recover_explode_factor)
     if args.weights:
         solver.load_weights(args.weights)
     if args.snapshot:
         solver.restore(args.snapshot)
+    if args.resume:
+        from .resilience import checkpoint
+        if args.resume == "auto":
+            if not prefix:
+                raise SystemExit("--resume auto needs a snapshot prefix "
+                                 "(--snapshot-prefix or the solver's "
+                                 "snapshot_prefix)")
+            checkpoint.resume_auto(solver, prefix, log_fn=print)
+        else:
+            solver.restore(args.resume)
     total = args.iterations or int(sp.max_iter) or 1000
     # device_put in the prefetch WORKER thread: the blocking host->HBM copy
     # of batch k+1 overlaps step k on the device (the H2D/compute overlap
@@ -219,25 +241,37 @@ def cmd_train(args):
     else:
         test_fn = (lambda: _make_data_iter(solver.test_net, seed=1)) \
             if solver.test_net is not None else None
-    prefix = args.snapshot_prefix or (
-        sp.snapshot_prefix if sp.has("snapshot_prefix") else None)
     policy = SignalPolicy(sigint=args.sigint_effect,
-                          sighup=args.sighup_effect)
+                          sighup=args.sighup_effect,
+                          sigterm=args.sigterm_effect)
     prof = JaxProfiler(args.profile)
+    from .resilience.chaos import active_chaos
+    from .resilience.recovery import RecoveryAbort
     blocks_done = 0
+    rc = 0
     try:
         with policy:
             while solver.iter < total:
                 prof.maybe_start(blocks_done, total - solver.iter)
                 n = min(100, total - solver.iter)
                 with tracer.span("train_block", iter0=solver.iter, iters=n):
-                    solver.step(n, data_iter, test_data_fn=test_fn)
+                    try:
+                        solver.step(n, data_iter, test_data_fn=test_fn)
+                    except RecoveryAbort as e:
+                        # clean abort: the run is over, but the last
+                        # known-good snapshot (if any) is intact on disk
+                        print(f"ABORT: {e}")
+                        rc = 3
+                        break
                 blocks_done += 1
                 prof.maybe_stop()
+                ch = active_chaos()
+                if ch is not None:
+                    ch.maybe_sigterm(blocks_done)
                 action = policy.pending()
-                if action == "snapshot":
+                if action in ("snapshot", "snapshot_stop"):
                     solver.snapshot(prefix=prefix or "snap")
-                elif action == "stop":
+                if action in ("stop", "snapshot_stop"):
                     print("stopping early on signal")
                     break
     finally:
@@ -260,12 +294,14 @@ def cmd_train(args):
     # --snapshot-prefix-only run must still get its tail snapshot.
     cadence_fired = int(sp.snapshot) and sp.has("snapshot_prefix") \
         and solver.iter % int(sp.snapshot) == 0
-    if prefix and sp.snapshot_after_train and not cadence_fired:
+    # on a recovery abort the in-memory params may be the diverged ones —
+    # never overwrite good snapshots with them
+    if prefix and sp.snapshot_after_train and not cadence_fired and rc == 0:
         solver.snapshot(prefix=prefix)
     print(f"Optimization done, iter={solver.iter}")
     if metrics:
         metrics.close()
-    return 0
+    return rc
 
 
 def cmd_test(args):
@@ -685,9 +721,36 @@ def main(argv=None):
                         "and ship float32 crops, instead of the default "
                         "on-device transform fed raw uint8 records")
     t.add_argument("--sigint_effect", default="stop",
-                   choices=("snapshot", "stop", "none"))
+                   choices=("snapshot", "stop", "snapshot_stop", "none"))
     t.add_argument("--sighup_effect", default="snapshot",
-                   choices=("snapshot", "stop", "none"))
+                   choices=("snapshot", "stop", "snapshot_stop", "none"))
+    t.add_argument("--sigterm_effect", default="snapshot_stop",
+                   choices=("snapshot", "stop", "snapshot_stop", "none"),
+                   help="preemption-notice handling; the default snapshots "
+                        "then stops, so `--resume auto` can continue")
+    t.add_argument("--resume", metavar="auto|STATE",
+                   help="'auto': continue from the newest valid snapshot "
+                        "under the snapshot prefix (partial/corrupt ones "
+                        "are skipped with a reason); or an explicit "
+                        ".solverstate[.h5] path")
+    t.add_argument("--keep", type=int, default=5,
+                   help="snapshot retention: keep the newest N manifested "
+                        "snapshots, delete older ones (0 = keep all)")
+    t.add_argument("--recover", type=int, default=0, metavar="N",
+                   help="arm divergence recovery: roll back to the last "
+                        "known-good state on NaN/exploding loss, up to N "
+                        "consecutive times before a clean abort (exit 3)")
+    t.add_argument("--recover-lr-decay", type=float, default=1.0,
+                   help="multiply the lr schedule by this on every "
+                        "rollback (e.g. 0.5)")
+    t.add_argument("--recover-explode-factor", type=float, default=0.0,
+                   help=">0: also roll back when the loss exceeds this "
+                        "factor times its recent healthy EMA")
+    t.add_argument("--chaos", metavar="SPEC",
+                   help="deterministic fault injection, e.g. "
+                        "'nan_step=30,io_p=0.02,sigterm_round=3,seed=1' "
+                        "(also via SPARKNET_CHAOS; see "
+                        "sparknet_tpu/resilience/chaos.py)")
     t.set_defaults(fn=cmd_train)
 
     te = sub.add_parser("test", help="score a model")

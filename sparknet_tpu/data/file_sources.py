@@ -61,6 +61,12 @@ class ImageDataSource:
         self.is_color = bool(is_color)
         self.shuffle = bool(shuffle)
         self.data_top, self.label_top = data_top, label_top
+        # transient-IO resilience: image decodes retry with backoff (a
+        # flaky NFS read costs a sleep, not the round); chaos injects
+        from ..resilience.retry import retry_from_env
+        from ..resilience.chaos import active_chaos
+        self._retry = retry_from_env()
+        self._chaos = active_chaos()
         self.rng = np.random.RandomState(seed)
         self.transformer = DataTransformer(transform_param, phase=phase,
                                            base_dir=base_dir, rng=self.rng)
@@ -96,6 +102,15 @@ class ImageDataSource:
             resize=(self.new_width, self.new_height)
             if self.new_height and self.new_width else None)
 
+    def _read_resilient(self, rel):
+        def read():
+            if self._chaos is not None:
+                self._chaos.maybe_io_error(rel)
+            return self._read(rel)
+        if self._retry is None:
+            return read()
+        return self._retry.call(read, where=rel)
+
     def _records(self):
         skip = self._skip
         self._skip = 0
@@ -104,7 +119,7 @@ class ImageDataSource:
                 if skip:
                     skip -= 1
                     continue
-                yield self._read(rel), label
+                yield self._read_resilient(rel), label
             if self.shuffle:                    # reshuffle on wrap
                 self.rng.shuffle(self.lines)
 
@@ -178,14 +193,20 @@ class HDF5DataSource:
     def num_batches(self):
         return max(1, self._count // self.batch_size)
 
+    def _load(self, p):
+        with self._h5py.File(p, "r") as f:
+            return {t: np.asarray(f[t]) for t in self.tops}
+
     def _rows(self):
+        from ..resilience.retry import retry_from_env
+        retry = retry_from_env()
         files = list(self.files)
         while True:
             if self.shuffle:
                 self.rng.shuffle(files)
             for p in files:
-                with self._h5py.File(p, "r") as f:
-                    data = {t: np.asarray(f[t]) for t in self.tops}
+                data = self._load(p) if retry is None \
+                    else retry.call(self._load, p, where=p)
                 n = len(data[self.tops[0]])
                 order = self.rng.permutation(n) if self.shuffle \
                     else np.arange(n)

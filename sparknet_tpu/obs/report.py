@@ -141,6 +141,39 @@ def aggregate(events):
         rep["summary"] = {k: v for k, v in summary[-1].items()
                           if k not in ("event", "t", "run")}
 
+    # -- resilience (sparknet_tpu.resilience) ------------------------------
+    rec = [e for e in events if e.get("event") == "recovery"]
+    if rec:
+        rep["recovery"] = {
+            "kinds": dict(collections.Counter(e.get("kind", "?")
+                                              for e in rec)),
+            "rollback_iters": [e.get("to_iter") for e in rec
+                               if e.get("kind") == "rollback"][:20],
+            "last_reason": rec[-1].get("reason")}
+    ch = [e for e in events if e.get("event") == "chaos"]
+    if ch:
+        rep["chaos"] = dict(collections.Counter(e.get("kind", "?")
+                                                for e in ch))
+    rt = [e for e in events if e.get("event") == "retry"]
+    if rt:
+        rep["retries"] = {
+            "count": len(rt),
+            "exhausted": sum(1 for e in rt if e.get("exhausted")),
+            "by_where": dict(collections.Counter(
+                str(e.get("where", "?")) for e in rt))}
+    cp = [e for e in events if e.get("event") == "checkpoint"]
+    if cp:
+        writes = [e for e in cp if e.get("kind") != "resume"]
+        resumes = [e for e in cp if e.get("kind") == "resume"]
+        c = {"count": len(writes)}
+        if writes:
+            c["last_iter"] = writes[-1].get("iter")
+            c["last_bytes"] = writes[-1].get("bytes")
+        if resumes:
+            c["resumed_from_iter"] = resumes[-1].get("iter")
+            c["resume_refused"] = resumes[-1].get("refused")
+        rep["checkpoints"] = c
+
     # -- auxiliary streams -------------------------------------------------
     wd = [e for e in events if e.get("event") == "watchdog"]
     if wd:
@@ -258,6 +291,36 @@ def render(rep):
         for k, v in sorted(rep["summary"].items()):
             L.append(f"  {k} = {v}")
 
+    if any(rep.get(k) for k in ("recovery", "chaos", "retries",
+                                "checkpoints")):
+        hdr("resilience")
+        cp = rep.get("checkpoints")
+        if cp:
+            line = f"  checkpoints: {cp.get('count', 0)}"
+            if cp.get("last_iter") is not None:
+                line += f" (last at iter {cp['last_iter']}, " \
+                        f"{_fmt_bytes(cp.get('last_bytes'))})"
+            L.append(line)
+            if cp.get("resumed_from_iter") is not None:
+                line = f"  resumed from iter {cp['resumed_from_iter']}"
+                if cp.get("resume_refused"):
+                    line += f" ({cp['resume_refused']} snapshot(s) refused)"
+                L.append(line)
+        r = rep.get("recovery")
+        if r:
+            L.append("  recovery: " + ", ".join(
+                f"{k}: {v}" for k, v in sorted(r["kinds"].items())))
+            if r.get("rollback_iters"):
+                L.append(f"    rolled back to iters {r['rollback_iters']}")
+            if r.get("last_reason"):
+                L.append(f"    last reason: {r['last_reason']}")
+        if rep.get("chaos"):
+            L.append("  chaos injected: " + ", ".join(
+                f"{k}: {v}" for k, v in sorted(rep["chaos"].items())))
+        rt = rep.get("retries")
+        if rt:
+            L.append(f"  io retries: {rt['count']} "
+                     f"({rt['exhausted']} exhausted)")
     if rep.get("watchdog"):
         hdr("watchdog")
         for k, v in sorted(rep["watchdog"].items()):

@@ -290,7 +290,7 @@ class DataParallelSolver(Solver):
         host_s = _t.perf_counter() - t0
         self._timing["train_step"] += host_s
         self._obs_step(host_s, loss, batch)
-        return loss
+        return self._chaos_loss(loss)
 
     def _build_eval_step(self):
         net = self.local_test_net
@@ -452,17 +452,65 @@ class LocalSGDSolver(Solver):
         host_s = _t.perf_counter() - t0
         self._timing["train_round"] += host_s
         self._obs_step(host_s, loss, batches)
-        return loss
+        return self._chaos_loss(loss)
 
-    def run(self, num_rounds, batch_fn, test_data_fn=None, test_every=10):
+    def run(self, num_rounds, batch_fn, test_data_fn=None, test_every=10,
+            snapshot_prefix=None, snapshot_every=0, resume=None,
+            sigint="stop", sighup="snapshot", sigterm="snapshot_stop"):
         """The reference driver loop (CifarApp.scala:92-135): for each round,
         optionally test (every ``test_every`` rounds, :98), then train tau
-        steps per worker. ``batch_fn(tau)`` -> batches dict as above."""
-        for r in range(num_rounds):
-            if test_data_fn is not None and r % test_every == 0 \
-                    and self.test_net is not None:
-                scores = self.test(test_data_fn())
-                for k, v in scores.items():
-                    self.log(f"round {r}: test {k} = {v}")
-            loss = self.train_round(batch_fn(self.tau))
-            self.log(f"round {r}: mean local loss = {float(loss):.6g}")
+        steps per worker. ``batch_fn(tau)`` -> batches dict as above.
+
+        Fault tolerance (the opposite of the reference's
+        spark.task.maxFailures=1 contract):
+          * resume="auto" restores the newest valid snapshot under the
+            prefix before the first round (a path restores that snapshot)
+          * signals are polled BETWEEN rounds: SIGHUP snapshots, SIGINT
+            stops cleanly, SIGTERM (a preemption notice) snapshots then
+            stops — pair with `--resume auto` on relaunch
+          * snapshot_every=N also snapshots every N completed rounds
+          * an armed RecoveryPolicy (arm_recovery) rolls a NaN/exploding
+            round back and redoes it instead of averaging poison
+        """
+        from ..utils.signals import SignalPolicy
+        from ..resilience import checkpoint
+        prefix = snapshot_prefix or (self.param.snapshot_prefix
+                                     if self.param.has("snapshot_prefix")
+                                     else None)
+        if resume == "auto":
+            if prefix:
+                checkpoint.resume_auto(self, prefix, log_fn=self.log)
+            else:
+                self.log("resume auto: no snapshot prefix; starting fresh")
+        elif resume:
+            self.restore(resume)
+        r = 0
+        with SignalPolicy(sigint=sigint, sighup=sighup,
+                          sigterm=sigterm) as policy:
+            while r < num_rounds:
+                if test_data_fn is not None and r % test_every == 0 \
+                        and self.test_net is not None:
+                    scores = self.test(test_data_fn())
+                    for k, v in scores.items():
+                        self.log(f"round {r}: test {k} = {v}")
+                loss = self.train_round(batch_fn(self.tau))
+                v = float(loss)
+                if self.watchdog is not None:
+                    self.watchdog.beat(v)
+                if self.recovery is not None and \
+                        self.recovery.observe(self, v):
+                    self.log(f"round {r}: rolled back to iter {self.iter}; "
+                             "redoing the round")
+                    continue
+                self.log(f"round {r}: mean local loss = {v:.6g}")
+                r += 1
+                if self.chaos is not None:
+                    self.chaos.maybe_sigterm(r)
+                action = policy.pending()
+                if prefix and (action in ("snapshot", "snapshot_stop") or
+                               (snapshot_every and
+                                r % snapshot_every == 0)):
+                    self.snapshot(prefix=prefix)
+                if action in ("stop", "snapshot_stop"):
+                    self.log(f"stopping on signal after round {r}")
+                    break

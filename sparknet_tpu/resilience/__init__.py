@@ -1,0 +1,44 @@
+"""Fault-tolerant training runtime.
+
+The reference ran with spark.task.maxFailures=1 (CifarApp.scala:38): any
+worker failure killed the whole job, because native solver state could not
+survive Spark's lineage replay (SURVEY.md section 5). This package makes the
+opposite contract hold — a preemption, a wedged device, a corrupt read, or a
+diverging loss costs at most one sync round, never the run:
+
+  checkpoint.py  crash-safe snapshots: write-temp -> fsync -> atomic-rename
+                 with per-file sha256 and a <prefix>.latest.json manifest
+                 that commits BOTH snapshot files (model + solver state) as
+                 one unit, keep-N retention, and find_resumable() /
+                 resume_auto() that skip partial or corrupt snapshots with
+                 a stated reason
+  recovery.py    RecoveryPolicy: in-memory last-known-good state; on a
+                 non-finite or exploding loss, roll params/state/history
+                 back, optionally decay the lr and reshuffle the stream,
+                 with bounded retries before a clean RecoveryAbort
+  retry.py       jittered exponential backoff with a retry budget, wrapped
+                 around the data sources so transient IO errors don't kill
+                 a round
+  chaos.py       deterministic, seed-driven fault injectors (NaN at step k,
+                 IO error with probability p, stall of s seconds, SIGTERM
+                 at round r) so every recovery path is exercised in CPU
+                 tests — armed via --chaos / SPARKNET_CHAOS
+
+Everything reports through the run's MetricsLogger (events: checkpoint,
+recovery, retry, chaos), so `sparknet report` shows failures and the
+recoveries next to the loss curve they interrupted.
+"""
+
+from .checkpoint import (save_snapshot, find_resumable, resume_auto,
+                         load_manifest, manifest_path, check_restorable)
+from .recovery import RecoveryPolicy, RecoveryAbort
+from .retry import RetryPolicy, RetryExhausted, retry_from_env
+from .chaos import ChaosMonkey, ChaosIOError, install_chaos, active_chaos
+
+__all__ = [
+    "save_snapshot", "find_resumable", "resume_auto", "load_manifest",
+    "manifest_path", "check_restorable",
+    "RecoveryPolicy", "RecoveryAbort",
+    "RetryPolicy", "RetryExhausted", "retry_from_env",
+    "ChaosMonkey", "ChaosIOError", "install_chaos", "active_chaos",
+]

@@ -1,0 +1,135 @@
+"""Deterministic, seed-driven fault injection.
+
+Recovery paths that only run during real outages are recovery paths that
+don't work. The ChaosMonkey injects the failure classes the resilience
+subsystem claims to survive — on a schedule tests can replay exactly:
+
+  nan_step=K       the loss observed at step K becomes NaN (once) —
+                   exercises RecoveryPolicy rollback
+  nan_repeat=1     ...at EVERY step >= K (persistent divergence) —
+                   exercises the bounded-retry abort
+  io_p=P           each data-source record read raises ChaosIOError with
+                   probability P (seeded rng) — exercises retry backoff
+  stall_step=K, stall_s=S   step K blocks the host for S seconds (once) —
+                   exercises the watchdog stall path
+  sigterm_round=R  the process SIGTERMs itself after round/block R (once)
+                   — exercises snapshot-then-stop + `--resume auto`
+
+Armed via `--chaos "nan_step=30,io_p=0.02,seed=1"` or the SPARKNET_CHAOS
+env var (same spec), which data sources and solvers pick up through
+active_chaos() without any plumbing. Every injection logs a ``chaos``
+metrics event so a report never confuses injected faults with real ones.
+"""
+
+import os
+import signal
+import time
+
+import numpy as np
+
+
+class ChaosIOError(OSError):
+    """An injected (not real) IO failure."""
+
+
+_UNSET = object()
+_active = _UNSET
+
+
+def install_chaos(monkey):
+    """Explicitly arm (or, with None, disarm) the process-wide monkey."""
+    global _active
+    _active = monkey
+    return monkey
+
+
+def active_chaos():
+    """The process-wide ChaosMonkey, arming from SPARKNET_CHAOS on first
+    use; None when chaos is off."""
+    global _active
+    if _active is _UNSET:
+        spec = os.environ.get("SPARKNET_CHAOS", "").strip()
+        _active = ChaosMonkey.parse(spec) if spec else None
+    return _active
+
+
+class ChaosMonkey:
+    def __init__(self, nan_step=None, nan_repeat=False, io_p=0.0,
+                 stall_step=None, stall_s=0.0, sigterm_round=None,
+                 seed=0, metrics=None, log_fn=print):
+        self.nan_step = None if nan_step is None else int(nan_step)
+        self.nan_repeat = bool(nan_repeat)
+        self.io_p = float(io_p)
+        self.stall_step = None if stall_step is None else int(stall_step)
+        self.stall_s = float(stall_s)
+        self.sigterm_round = None if sigterm_round is None \
+            else int(sigterm_round)
+        self._rng = np.random.RandomState(seed)
+        self.metrics = metrics
+        self.log = log_fn or (lambda *a: None)
+        self._nan_fired = False
+        self._stall_fired = False
+        self._term_fired = False
+        self.injected = 0
+
+    @classmethod
+    def parse(cls, spec, **kw):
+        """"nan_step=30,io_p=0.05,stall_step=10,stall_s=2,sigterm_round=3,
+        seed=1" -> ChaosMonkey. Unknown keys are an error (a typo'd chaos
+        spec silently injecting nothing would fake a green test)."""
+        fields = {}
+        for part in spec.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            k, eq, v = part.partition("=")
+            if not eq:
+                raise ValueError(f"chaos spec needs key=value, got {part!r}")
+            fields[k.strip()] = v.strip()
+        known = {"nan_step": int, "nan_repeat": lambda v: v not in
+                 ("0", "false", "False", ""), "io_p": float,
+                 "stall_step": int, "stall_s": float,
+                 "sigterm_round": int, "seed": int}
+        unknown = set(fields) - set(known)
+        if unknown:
+            raise ValueError(f"unknown chaos keys {sorted(unknown)} "
+                             f"(known: {sorted(known)})")
+        return cls(**{k: known[k](v) for k, v in fields.items()}, **kw)
+
+    def _event(self, kind, **fields):
+        self.injected += 1
+        self.log(f"[chaos] injecting {kind} "
+                 + " ".join(f"{k}={v}" for k, v in fields.items()))
+        if self.metrics is not None:
+            self.metrics.log("chaos", kind=kind, **fields)
+
+    # -- injectors ---------------------------------------------------------
+    def poison_loss(self, it):
+        """True when the loss at step ``it`` should be replaced by NaN."""
+        if self.nan_step is None or it < self.nan_step:
+            return False
+        if self._nan_fired and not self.nan_repeat:
+            return False
+        if not self._nan_fired:
+            self._event("nan", iter=it)
+        self._nan_fired = True
+        return True
+
+    def maybe_io_error(self, where=""):
+        if self.io_p > 0 and self._rng.random_sample() < self.io_p:
+            self._event("io_error", where=where)
+            raise ChaosIOError(f"injected IO error reading {where or '?'}")
+
+    def maybe_stall(self, it):
+        if self.stall_step is not None and not self._stall_fired \
+                and it >= self.stall_step and self.stall_s > 0:
+            self._stall_fired = True
+            self._event("stall", iter=it, seconds=self.stall_s)
+            time.sleep(self.stall_s)
+
+    def maybe_sigterm(self, round_):
+        if self.sigterm_round is not None and not self._term_fired \
+                and round_ >= self.sigterm_round:
+            self._term_fired = True
+            self._event("sigterm", round=round_)
+            os.kill(os.getpid(), signal.SIGTERM)

@@ -1,0 +1,287 @@
+"""Crash-safe checkpointing over the Solver's snapshot formats.
+
+The base Solver writes model then state as two independent files; a crash
+between the two (or mid-write) leaves a snapshot that pairs new weights
+with stale history — silently wrong to resume from. The commit protocol
+here makes a snapshot either fully visible or invisible:
+
+  1. both files are written under temp names IN the final directory
+     (same filesystem, so the later rename is atomic)
+  2. each temp file is fsync'd and sha256'd
+  3. both are atomic-renamed to their final names; the directory is fsync'd
+  4. <prefix>.latest.json is committed last (temp + fsync + rename): the
+     manifest entry names BOTH files with their checksums, so the pair is
+     one atomic unit — a crash at any earlier point leaves the previous
+     manifest pointing at the previous complete snapshot
+  5. retention: manifest history beyond keep-N is dropped and only files
+     the manifest itself recorded are deleted
+
+find_resumable() walks the manifest newest-first, verifying existence and
+checksums, and falls back to un-manifested legacy snapshot pairs; every
+snapshot it refuses is reported with the reason. resume_auto() is the
+`--resume auto` entry point: restore the newest valid state, or start
+fresh when there is none.
+"""
+
+import glob
+import hashlib
+import json
+import os
+import re
+import time
+
+
+MANIFEST_SUFFIX = ".latest.json"
+_TMP_TAG = ".tmp."
+
+
+def manifest_path(prefix):
+    return prefix + MANIFEST_SUFFIX
+
+
+def _sha256(path, chunk=1 << 20):
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        while True:
+            b = f.read(chunk)
+            if not b:
+                break
+            h.update(b)
+    return h.hexdigest()
+
+
+def _fsync_file(path):
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _fsync_dir(dirname):
+    """Durability of the rename itself. Best-effort: some filesystems
+    refuse O_RDONLY on directories."""
+    try:
+        fd = os.open(dirname or ".", os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def _atomic_write_json(path, obj):
+    tmp = f"{path}{_TMP_TAG}{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(obj, f, indent=1, sort_keys=True)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    _fsync_dir(os.path.dirname(path))
+
+
+def load_manifest(prefix):
+    """The manifest dict, or None when missing/corrupt (a torn manifest
+    write must read as "no manifest", not an error)."""
+    try:
+        with open(manifest_path(prefix)) as f:
+            man = json.load(f)
+    except (OSError, ValueError):
+        return None
+    return man if isinstance(man, dict) else None
+
+
+def save_snapshot(solver, prefix, format=None, keep=None, metrics=None):
+    """Atomically write one (model, state) snapshot pair for ``solver``
+    and commit it to the manifest; returns the final paths.
+
+    ``keep``: retention — manifest entries beyond the newest N are
+    dropped and their files deleted. None/0 keeps everything.
+    """
+    model_path, state_path, format = solver._snapshot_paths(prefix, format)
+    d = os.path.dirname(model_path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    tag = f"{_TMP_TAG}{os.getpid()}"
+    tmp_model, tmp_state = model_path + tag, state_path + tag
+    try:
+        # the state file embeds the model path (SolverState.learned_net) —
+        # it must name the FINAL path, not the temp name
+        solver._write_snapshot_files(tmp_model, tmp_state, format,
+                                     learned_net=model_path)
+        for p in (tmp_model, tmp_state):
+            _fsync_file(p)
+        entry = {
+            "iter": int(solver.iter),
+            "format": format,
+            "model": os.path.basename(model_path),
+            "state": os.path.basename(state_path),
+            "sha256": {"model": _sha256(tmp_model),
+                       "state": _sha256(tmp_state)},
+            "bytes": {"model": os.path.getsize(tmp_model),
+                      "state": os.path.getsize(tmp_state)},
+            "time": round(time.time(), 3),
+        }
+        os.replace(tmp_model, model_path)
+        os.replace(tmp_state, state_path)
+        _fsync_dir(d)
+    finally:
+        for p in (tmp_model, tmp_state):        # never leave partials
+            if os.path.exists(p):
+                try:
+                    os.remove(p)
+                except OSError:
+                    pass
+
+    man = load_manifest(prefix) or {}
+    snaps = [e for e in man.get("snapshots", ())
+             if isinstance(e, dict) and
+             not (e.get("iter") == entry["iter"] and
+                  e.get("format") == format)]
+    snaps.append(entry)
+    snaps.sort(key=lambda e: (e.get("iter", -1), e.get("time", 0)))
+    dropped = []
+    if keep and int(keep) > 0 and len(snaps) > int(keep):
+        dropped, snaps = snaps[:-int(keep)], snaps[-int(keep):]
+    _atomic_write_json(manifest_path(prefix),
+                       {"version": 1, "latest": entry, "snapshots": snaps})
+    for e in dropped:
+        for k in ("model", "state"):
+            name = e.get(k)
+            if not name:
+                continue
+            try:
+                os.remove(os.path.join(d, name))
+            except OSError:
+                pass
+    if metrics is not None:
+        metrics.log("checkpoint", iter=entry["iter"], format=format,
+                    model=model_path, state=state_path,
+                    bytes=entry["bytes"]["model"] + entry["bytes"]["state"],
+                    kept=len(snaps), dropped=len(dropped))
+    return model_path, state_path
+
+
+def _verify_entry(d, entry):
+    """Reason string this manifest entry is not restorable, or None."""
+    for k in ("model", "state"):
+        name = entry.get(k)
+        if not name:
+            return f"manifest entry has no {k} file recorded"
+        path = os.path.join(d, name)
+        if not os.path.exists(path):
+            return f"{k} file {name} is missing"
+        if os.path.getsize(path) == 0:
+            return f"{k} file {name} is empty"
+        want = (entry.get("sha256") or {}).get(k)
+        if want and _sha256(path) != want:
+            return f"{k} file {name} fails its sha256 check " \
+                   "(truncated or corrupt)"
+    return None
+
+
+_ITER_RE = re.compile(r"_iter_(\d+)\.solverstate(\.h5)?$")
+
+
+def _legacy_pairs(prefix):
+    """Un-manifested (iter, model, state) snapshot pairs, newest first."""
+    pairs = []
+    for state in glob.glob(glob.escape(prefix) + "_iter_*.solverstate*"):
+        if _TMP_TAG in state:
+            continue
+        m = _ITER_RE.search(state)
+        if not m:
+            continue
+        model = state[:m.start()] + f"_iter_{m.group(1)}.caffemodel" \
+            + (m.group(2) or "")
+        pairs.append((int(m.group(1)), model, state))
+    return sorted(pairs, reverse=True)
+
+
+def find_resumable(prefix, log_fn=None):
+    """Newest valid snapshot for ``prefix`` -> (state_path, skipped).
+
+    skipped is [(state_path, reason), ...] for every newer snapshot that
+    was refused (partial write, checksum mismatch, missing pair file).
+    Returns (None, skipped) when nothing valid exists. Manifested
+    snapshots are checksum-verified; legacy un-manifested pairs are only
+    checked for existence and non-emptiness.
+    """
+    log = log_fn or (lambda *a: None)
+    skipped = []
+    seen_states = set()
+    d = os.path.dirname(prefix)
+    man = load_manifest(prefix)
+    for entry in reversed((man or {}).get("snapshots", [])):
+        if not isinstance(entry, dict):
+            continue
+        state = os.path.join(d, entry.get("state") or "?")
+        seen_states.add(os.path.basename(state))
+        reason = _verify_entry(d, entry)
+        if reason is None:
+            for s, r in skipped:
+                log(f"refusing snapshot {s}: {r}")
+            return state, skipped
+        skipped.append((state, reason))
+    for it, model, state in _legacy_pairs(prefix):
+        if os.path.basename(state) in seen_states:
+            continue            # manifest already ruled on this one
+        if not os.path.exists(model):
+            skipped.append((state, f"model file {model} is missing"))
+            continue
+        if os.path.getsize(model) == 0 or os.path.getsize(state) == 0:
+            skipped.append((state, "snapshot pair has an empty file "
+                            "(partial write)"))
+            continue
+        for s, r in skipped:
+            log(f"refusing snapshot {s}: {r}")
+        return state, skipped
+    for s, r in skipped:
+        log(f"refusing snapshot {s}: {r}")
+    return None, skipped
+
+
+def check_restorable(state_path):
+    """Guard an explicit restore(): if a manifest in the snapshot's
+    directory covers this state file, verify the whole pair and raise
+    ValueError naming the snapshot and the reason when it fails. Temp
+    files from torn writes are always refused. Un-manifested snapshots
+    pass through (legacy callers)."""
+    if _TMP_TAG in os.path.basename(state_path):
+        raise ValueError(f"refusing snapshot {state_path}: temp file from "
+                         "an interrupted snapshot write")
+    d = os.path.dirname(state_path)
+    base = os.path.basename(state_path)
+    for man_file in glob.glob(os.path.join(glob.escape(d) if d else ".",
+                                           "*" + MANIFEST_SUFFIX)):
+        prefix = man_file[:-len(MANIFEST_SUFFIX)]
+        man = load_manifest(prefix)
+        for entry in (man or {}).get("snapshots", []):
+            if isinstance(entry, dict) and entry.get("state") == base:
+                reason = _verify_entry(d, entry)
+                if reason is not None:
+                    raise ValueError(
+                        f"refusing snapshot {state_path}: {reason}")
+                return
+
+
+def resume_auto(solver, prefix, log_fn=None):
+    """`--resume auto`: restore ``solver`` from the newest valid snapshot
+    under ``prefix``; returns the state path used, or None (fresh start).
+    Every refused snapshot is logged with its reason."""
+    log = log_fn or (lambda *a: None)
+    state, skipped = find_resumable(prefix, log_fn=log)
+    if state is None:
+        log(f"resume auto: no resumable snapshot under {prefix!r}"
+            + (f" ({len(skipped)} refused)" if skipped else "")
+            + "; starting fresh")
+        return None
+    solver.restore(state)
+    log(f"resume auto: restored iter {solver.iter} from {state}")
+    if getattr(solver, "metrics", None) is not None:
+        solver.metrics.log("checkpoint", kind="resume", iter=solver.iter,
+                           state=state, refused=len(skipped))
+    return state

@@ -1,0 +1,147 @@
+"""Divergence recovery: roll back to last-known-good instead of dying.
+
+The watchdog (PR 1) made NaN/inf losses *visible*; this makes them
+survivable. A RecoveryPolicy keeps an in-memory last-known-good copy of
+the solver's params/state/history (host-resident numpy, so buffer
+donation can't invalidate it) and, when a loss comes back non-finite or
+exploded, rewinds the solver to it — optionally decaying the lr and
+reshuffling the data stream — so one bad round degrades into a short
+replay instead of poisoning the averaged weights. Retries are bounded:
+after ``max_rollbacks`` rollbacks without reaching a new known-good
+point past the failure, it raises RecoveryAbort for a clean exit the
+supervisor can tell apart from a crash.
+
+Wired into Solver.step (loss sync/display points — losses are observed
+with up to the async-dispatch lag, which only delays the rollback by
+that many steps) and LocalSGDSolver.run (per-round).
+"""
+
+import math
+
+import numpy as np
+
+
+class RecoveryAbort(RuntimeError):
+    """Divergence persisted through the rollback budget; stop cleanly."""
+
+
+class RecoveryPolicy:
+    """observe(solver, loss) after each materialized loss:
+
+    healthy  -> refresh the last-known-good copy (at most every
+                ``good_interval`` iters) and return False
+    bad      -> roll the solver back and return True (caller should
+                redo the work), or raise RecoveryAbort once
+                ``max_rollbacks`` consecutive rollbacks have not reached
+                a new healthy point past the failure iter
+
+    A loss is bad when it is non-finite, or — with ``explode_factor`` > 0
+    — larger than explode_factor x the EMA of recent healthy losses.
+    ``lr_decay`` < 1 multiplies the lr schedule on every rollback (the
+    compiled step is rebuilt; a recompile per rare rollback is cheap
+    next to a dead run). ``reshuffle`` is an optional zero-arg hook to
+    re-seed/skip the data stream so the replay doesn't hit the exact
+    batch sequence that diverged.
+    """
+
+    def __init__(self, max_rollbacks=3, lr_decay=1.0, explode_factor=0.0,
+                 good_interval=1, ema_decay=0.9, reshuffle=None,
+                 metrics=None, log_fn=print):
+        self.max_rollbacks = int(max_rollbacks)
+        self.lr_decay = float(lr_decay)
+        self.explode_factor = float(explode_factor)
+        self.good_interval = max(1, int(good_interval))
+        self.ema_decay = float(ema_decay)
+        self.reshuffle = reshuffle
+        self.metrics = metrics
+        self.log = log_fn or (lambda *a: None)
+        self.rollbacks = 0          # lifetime count (for reporting)
+        self._consecutive = 0
+        self._ema = None
+        self._good = None           # (iter, params, state, history, rng)
+        self._good_iter = -1
+
+    # -- last-known-good capture -------------------------------------------
+    def note_good(self, solver):
+        """Snapshot the solver's training state to host memory."""
+        import jax
+        if self._good is not None and \
+                solver.iter - self._good_iter < self.good_interval:
+            return
+        get = jax.device_get
+        self._good = (solver.iter, get(solver.params), get(solver.state),
+                      get(solver.history), np.asarray(solver.rng))
+        self._good_iter = solver.iter
+
+    def is_bad(self, loss):
+        v = float(loss)
+        if not math.isfinite(v):
+            return "non-finite loss"
+        if self.explode_factor > 0 and self._ema is not None and \
+                abs(v) > self.explode_factor * max(abs(self._ema), 1e-8):
+            return (f"loss {v:.6g} exploded past "
+                    f"{self.explode_factor:g}x EMA {self._ema:.6g}")
+        return None
+
+    def observe(self, solver, loss):
+        """-> True if the solver was rolled back (redo the work)."""
+        if loss is None:
+            return False
+        v = float(loss)
+        reason = self.is_bad(v)
+        if reason is None:
+            self._ema = v if self._ema is None else \
+                self.ema_decay * self._ema + (1 - self.ema_decay) * v
+            if self._consecutive and solver.iter > self._good_iter:
+                self._consecutive = 0       # healthy past the failure point
+            self.note_good(solver)
+            return False
+        return self._rollback(solver, v, reason)
+
+    # -- the rollback itself -----------------------------------------------
+    def _rollback(self, solver, v, reason):
+        import jax
+        import jax.numpy as jnp
+        if self._good is None:
+            self._abort(solver, v, reason
+                        + " before any known-good state was captured")
+        self.rollbacks += 1
+        self._consecutive += 1
+        if self._consecutive > self.max_rollbacks:
+            self._abort(solver, v, f"{reason}; {self._consecutive - 1} "
+                        "rollbacks exhausted without progress")
+        it, params, state, history, rng = self._good
+        asarray = jnp.asarray
+        solver.params = jax.tree_util.tree_map(asarray, params)
+        solver.state = jax.tree_util.tree_map(asarray, state)
+        solver.history = jax.tree_util.tree_map(asarray, history)
+        solver.rng = jnp.asarray(rng)
+        solver.iter = it
+        solver._it_dev = None               # re-seed the device counter
+        solver._smoothed.clear()            # the window is poisoned
+        if self.lr_decay != 1.0:
+            solver.scale_lr(self.lr_decay)
+        if self.reshuffle is not None:
+            try:
+                self.reshuffle()
+            except Exception as e:          # a hook must not kill recovery
+                self.log(f"recovery: reshuffle hook raised: {e!r}")
+        self.log(f"recovery: {reason}; rolled back to iter {it} "
+                 f"(rollback {self._consecutive}/{self.max_rollbacks}"
+                 + (f", lr x{self.lr_decay:g}" if self.lr_decay != 1.0
+                    else "") + ")")
+        if self.metrics is not None:
+            self.metrics.log("recovery", kind="rollback", reason=reason,
+                             loss=v, to_iter=it,
+                             attempt=self._consecutive,
+                             lr_decay=self.lr_decay)
+        return True
+
+    def _abort(self, solver, v, reason):
+        if self.metrics is not None:
+            self.metrics.log("recovery", kind="abort", reason=reason,
+                             loss=v, iter=solver.iter,
+                             rollbacks=self.rollbacks)
+        self.log(f"recovery: ABORT at iter {solver.iter}: {reason}")
+        raise RecoveryAbort(f"training diverged at iter {solver.iter}: "
+                            f"{reason}")

@@ -91,6 +91,14 @@ class Solver:
             self.stepstats = StepAccounting(self.metrics)
             self.comms = CommsMeter(self.metrics)
         self.watchdog = None
+        # resilience hooks (sparknet_tpu.resilience): keep-N snapshot
+        # retention (None = keep all), an optional RecoveryPolicy armed via
+        # arm_recovery(), and the process-wide chaos injector (None unless
+        # --chaos / SPARKNET_CHAOS armed one)
+        self.snapshot_keep = None
+        self.recovery = None
+        from ..resilience.chaos import active_chaos
+        self.chaos = active_chaos()
         train_np, test_np = resolve_nets(solver_param, base_dir, net_param)
         # NetState from the solver (reference solver.cpp InitTrainNet /
         # InitTestNets: train_state / test_state merge into the filter
@@ -323,11 +331,57 @@ class Solver:
         return jax.jit(ev)
 
     def arm_watchdog(self, stall_seconds=300.0, **kw):
-        """Start a stall/NaN watchdog that step() beats each iteration."""
+        """Start a stall/NaN watchdog that step() beats each iteration.
+        With kill_on_stall and a configured snapshot_prefix, the exit path
+        gets a best-effort emergency snapshot by default."""
         from ..utils.watchdog import Watchdog
         kw.setdefault("metrics", self.metrics)
+        if kw.get("kill_on_stall") and "emergency_snapshot" not in kw \
+                and self.param.has("snapshot_prefix"):
+            kw["emergency_snapshot"] = self.snapshot
         self.watchdog = Watchdog(stall_seconds=stall_seconds, **kw).start()
         return self.watchdog
+
+    # -- resilience (sparknet_tpu.resilience) ------------------------------
+    def arm_recovery(self, policy=None, **kw):
+        """Install a divergence RecoveryPolicy (NaN/explosion -> rollback
+        to last-known-good). The state at arm time becomes the first
+        known-good point, so even a first-step NaN has somewhere to go."""
+        if policy is None:
+            from ..resilience.recovery import RecoveryPolicy
+            kw.setdefault("metrics", self.metrics)
+            kw.setdefault("log_fn", self.log)
+            policy = RecoveryPolicy(**kw)
+        self.recovery = policy
+        policy.note_good(self)
+        return policy
+
+    def scale_lr(self, factor):
+        """Scale the lr schedule by ``factor`` from now on. The schedule
+        is traced into the compiled step, so the jitted programs are
+        invalidated — one recompile per call (rollbacks are rare)."""
+        base, factor = self.lr_fn, float(factor)
+        self.lr_fn = lambda it: base(it) * factor
+        self._jit_train = None
+        if hasattr(self, "_jit_round"):
+            self._jit_round = None
+
+    def _chaos_loss(self, loss):
+        """Apply armed per-step chaos injectors (stall, loss poisoning)
+        to the step that just dispatched; no-op when chaos is off."""
+        if self.chaos is None:
+            return loss
+        self.chaos.maybe_stall(self.iter - 1)
+        if self.chaos.poison_loss(self.iter - 1):
+            return jnp.asarray(float("nan"), jnp.float32)
+        return loss
+
+    def _maybe_recover(self, loss):
+        """Feed a materialized loss to the recovery policy; True when the
+        solver was rolled back (the caller should redo the work)."""
+        if self.recovery is None or loss is None:
+            return False
+        return self.recovery.observe(self, float(loss))
 
     # -- observability (sparknet_tpu.obs) ----------------------------------
     def _register_comms(self, cm):
@@ -447,7 +501,7 @@ class Solver:
         host_s = time.perf_counter() - t0
         self._timing["train_step"] += host_s
         self._obs_step(host_s, loss, batch)
-        return loss
+        return self._chaos_loss(loss)
 
     def step(self, num_iters, data_iter, test_data_fn=None):
         """Run ``num_iters`` steps (the analog of ccaffe solver_step): pulls
@@ -499,6 +553,9 @@ class Solver:
                     v = float(loss)
                     if self.watchdog is not None:
                         self.watchdog.beat(v)
+                    if self._maybe_recover(v):
+                        t_last, it_last = time.time(), self.iter
+                        continue        # rolled back; redo from there
                 elif self.watchdog is not None:
                     self.watchdog.beat()
             if disp:
@@ -506,6 +563,11 @@ class Solver:
                 sm = self.smoothed_loss()
                 if self.watchdog is not None:
                     self.watchdog.beat(sm)
+                if self._maybe_recover(sm):
+                    # rolled back; restart the throughput window too (the
+                    # iter counter went backwards)
+                    t_last, it_last = time.time(), self.iter
+                    continue
                 lr = float(self.lr_fn(self.iter - 1))
                 self.log(f"Iteration {self.iter - 1}, loss = {sm:.6g}, "
                          f"lr = {lr:.6g}")
@@ -565,39 +627,59 @@ class Solver:
         with self.tracer.span("snapshot", iter=self.iter):
             return self._snapshot(prefix, format)
 
-    def _snapshot(self, prefix=None, format=None):
-        from . import hdf5_io
+    def _snapshot_paths(self, prefix=None, format=None):
+        """-> (model_path, state_path, format) for a snapshot at the
+        current iter (reference Snapshot naming, solver.cpp:466-470)."""
         prefix = prefix or self.param.snapshot_prefix
-        d = os.path.dirname(prefix)
-        if d:
-            os.makedirs(d, exist_ok=True)
         if format is None:
             format = "hdf5" if int(self.param.snapshot_format) == 0 \
                 else "binaryproto"
+        ext = ".h5" if format == "hdf5" else ""
+        return (f"{prefix}_iter_{self.iter}.caffemodel{ext}",
+                f"{prefix}_iter_{self.iter}.solverstate{ext}", format)
+
+    def _write_snapshot_files(self, model_path, state_path, format,
+                              learned_net=None):
+        """Write the two snapshot files to the given (possibly temporary)
+        paths; ``learned_net`` is the model path the state file should
+        reference — the FINAL name when writing through the atomic
+        checkpoint protocol."""
+        from . import hdf5_io
+        learned = learned_net or model_path
         if format == "hdf5":
-            model_path = f"{prefix}_iter_{self.iter}.caffemodel.h5"
-            state_path = f"{prefix}_iter_{self.iter}.solverstate.h5"
             hdf5_io.save_net_hdf5(model_path, self.net, self.params)
-            hdf5_io.save_state_hdf5(state_path, self.iter, model_path,
+            hdf5_io.save_state_hdf5(state_path, self.iter, learned,
                                     self.net, self.history)
         else:
-            model_path = f"{prefix}_iter_{self.iter}.caffemodel"
-            state_path = f"{prefix}_iter_{self.iter}.solverstate"
             net_proto = self.net.params_to_netproto(self.params, self.state)
             wire.dump(net_proto, model_path)
             ss = Message("SolverState", iter=self.iter,
-                         learned_net=model_path, current_step=0)
+                         learned_net=learned, current_step=0)
             # caffe history_ vector order: slot-major over net-ordered params
             for lname, i, s in hdf5_io.history_order(self.net, self.history):
                 ss.history.append(
                     array_to_blob(np.asarray(self.history[lname][i][s])))
             wire.dump(ss, state_path)
+
+    def _snapshot(self, prefix=None, format=None):
+        # every snapshot goes through the crash-safe commit protocol:
+        # temp-write -> fsync -> atomic rename -> manifest (the manifest
+        # covers model+state as ONE unit; see resilience/checkpoint.py)
+        from ..resilience import checkpoint
+        prefix = prefix or self.param.snapshot_prefix
+        model_path, state_path = checkpoint.save_snapshot(
+            self, prefix, format=format, keep=self.snapshot_keep,
+            metrics=self.metrics)
         self.log(f"Snapshotting to {model_path}")
         return model_path, state_path
 
     def restore(self, state_path):
-        """Resume from a .solverstate[.h5] (+ its learned_net weights)."""
+        """Resume from a .solverstate[.h5] (+ its learned_net weights).
+        Snapshots a manifest marks partial/corrupt are refused with the
+        reason (resilience/checkpoint.py)."""
         from . import hdf5_io
+        from ..resilience import checkpoint
+        checkpoint.check_restorable(state_path)
         self._it_dev = None          # re-seed the device iter counter
         if state_path.endswith(".h5"):
             it, learned, self.history = hdf5_io.load_state_hdf5(

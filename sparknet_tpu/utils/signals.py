@@ -4,20 +4,26 @@ The reference installs SIGINT/SIGHUP handlers whose effects (snapshot /
 stop / none) come from CLI flags (util/signal_handler.cpp:99-112,
 tools/caffe.cpp:43-46); the solver polls CheckForSignals between steps.
 Same design: handlers only record; the training loop polls pending().
+
+Beyond the reference: SIGTERM — the preemption notice every scheduler
+(k8s, borg, spot VMs) sends before a kill — maps to "snapshot_stop"
+(snapshot, then stop cleanly), so a preempted job loses at most the
+steps since its last sync round and `--resume auto` picks it back up.
 """
 
 import signal
 
 
-ACTIONS = ("snapshot", "stop", "none")
+ACTIONS = ("snapshot", "stop", "snapshot_stop", "none")
 
 
 class SignalPolicy:
-    def __init__(self, sigint="stop", sighup="snapshot"):
-        for a in (sigint, sighup):
+    def __init__(self, sigint="stop", sighup="snapshot", sigterm="none"):
+        for a in (sigint, sighup, sigterm):
             if a not in ACTIONS:
                 raise ValueError(f"unknown signal action {a!r}")
-        self.effects = {signal.SIGINT: sigint, signal.SIGHUP: sighup}
+        self.effects = {signal.SIGINT: sigint, signal.SIGHUP: sighup,
+                        signal.SIGTERM: sigterm}
         self._pending = []
         self._prev = {}
 
@@ -25,14 +31,17 @@ class SignalPolicy:
         action = self.effects.get(signum, "none")
         if action == "none":
             return
-        if action == "stop" and "stop" in self._pending:
+        if signum == signal.SIGINT and "stop" in action \
+                and any("stop" in p for p in self._pending):
             # second ^C: restore default and re-raise (escape hatch)
             signal.signal(signal.SIGINT, signal.SIG_DFL)
             raise KeyboardInterrupt
         self._pending.append(action)
 
     def __enter__(self):
-        for signum in self.effects:
+        for signum, action in self.effects.items():
+            if action == "none" and signum == signal.SIGTERM:
+                continue          # leave the default die-on-TERM alone
             try:
                 self._prev[signum] = signal.signal(signum, self._handler)
             except ValueError:        # non-main thread: polling still works
@@ -45,6 +54,6 @@ class SignalPolicy:
         return False
 
     def pending(self):
-        """Pop the oldest pending action ('snapshot'|'stop') or None —
-        the Solver::GetRequestedAction analog."""
+        """Pop the oldest pending action ('snapshot'|'stop'|
+        'snapshot_stop') or None — the Solver::GetRequestedAction analog."""
         return self._pending.pop(0) if self._pending else None

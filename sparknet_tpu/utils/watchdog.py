@@ -32,13 +32,22 @@ class Watchdog:
     """
 
     def __init__(self, stall_seconds=300.0, on_stall=None, on_nan=None,
-                 kill_on_stall=False, poll_seconds=None, metrics=None):
+                 kill_on_stall=False, poll_seconds=None, metrics=None,
+                 emergency_snapshot=None, emergency_timeout_s=30.0,
+                 exit_fn=None):
         self.stall_seconds = float(stall_seconds)
         self.on_stall = on_stall or (lambda dt: print(
             f"[watchdog] no training step for {dt:.0f}s"))
         self.on_nan = on_nan or (lambda loss: print(
             f"[watchdog] non-finite loss {loss}"))
         self.kill_on_stall = kill_on_stall
+        # kill path state preservation: a zero-arg snapshot callback tried
+        # best-effort (own thread, bounded by emergency_timeout_s — a
+        # wedged device can hang a snapshot too), then a final metrics
+        # flush, THEN os._exit(42). exit_fn is injectable for tests.
+        self.emergency_snapshot = emergency_snapshot
+        self.emergency_timeout_s = float(emergency_timeout_s)
+        self._exit = exit_fn or os._exit
         self.metrics = metrics
         self.poll = poll_seconds or min(10.0, self.stall_seconds / 4)
         self._last = time.monotonic()
@@ -82,8 +91,37 @@ class Watchdog:
                     print(f"[watchdog] on_stall raised: {e!r}",  # kill the
                           file=sys.stderr)                # monitor thread
                 if self.kill_on_stall:
-                    os._exit(42)
+                    self._emergency_exit()
                 self._last = time.monotonic()   # re-arm
+
+    def _emergency_exit(self):
+        """Best-effort snapshot + metrics flush, then exit 42 (the code
+        DEPLOY.md tells supervisors to restart with --resume auto)."""
+        ok = None
+        if self.emergency_snapshot is not None:
+            result = {}
+
+            def work():
+                try:
+                    result["path"] = self.emergency_snapshot()
+                except Exception as e:
+                    result["error"] = repr(e)
+
+            t = threading.Thread(target=work, daemon=True,
+                                 name="sparknet-emergency-snapshot")
+            t.start()
+            t.join(self.emergency_timeout_s)
+            ok = "error" not in result and not t.is_alive()
+            if not ok:
+                print("[watchdog] emergency snapshot "
+                      + ("timed out" if t.is_alive()
+                         else f"failed: {result.get('error')}"),
+                      file=sys.stderr)
+        if self.metrics is not None:
+            self.metrics.log("watchdog", kind="killed", exit_code=42,
+                             emergency_snapshot_ok=ok)
+            self.metrics.close()            # final flush before _exit
+        self._exit(42)
 
     def stop(self):
         self._stop.set()

@@ -1,0 +1,453 @@
+"""Fault-tolerance tests (sparknet_tpu.resilience, ISSUE 2).
+
+The contract under test is the inverse of the reference's
+spark.task.maxFailures=1: a preemption, corrupt read, or diverging loss
+costs at most one sync round. Kill/resume equivalence is checked
+bit-for-bit in both snapshot formats; every recovery path is driven by
+the deterministic chaos injectors rather than by luck.
+"""
+
+import json
+import os
+import signal
+import time
+
+import numpy as np
+import pytest
+
+from sparknet_tpu.proto import Message
+from sparknet_tpu.solver import Solver
+from sparknet_tpu.resilience import (
+    ChaosMonkey, RecoveryAbort, RetryExhausted, RetryPolicy,
+    find_resumable, load_manifest, manifest_path, resume_auto)
+from sparknet_tpu.utils.metrics import MetricsLogger
+
+
+def make_sp(**kw):
+    return Message("SolverParameter", **kw)
+
+
+def _mlp_net():
+    net = Message("NetParameter", name="mlp")
+    net.add("layer", name="d", type="JavaData", top=["data"],
+            java_data_param=dict(shape=dict(dim=[16, 8])))
+    net.add("layer", name="l", type="JavaData", top=["label"],
+            java_data_param=dict(shape=dict(dim=[16])))
+    net.add("layer", name="fc1", type="InnerProduct", bottom=["data"],
+            top=["fc1"], inner_product_param=dict(
+                num_output=16, weight_filler=dict(type="xavier")))
+    net.add("layer", name="r1", type="ReLU", bottom=["fc1"], top=["fc1"])
+    net.add("layer", name="fc2", type="InnerProduct", bottom=["fc1"],
+            top=["fc2"], inner_product_param=dict(
+                num_output=4, weight_filler=dict(type="xavier")))
+    net.add("layer", name="loss", type="SoftmaxWithLoss",
+            bottom=["fc2", "label"], top=["loss"])
+    return net
+
+
+def _toy_batches(n, seed=0):
+    rs = np.random.RandomState(seed)
+    while True:
+        yield {"data": rs.randn(n, 8).astype(np.float32),
+               "label": rs.randint(0, 4, n).astype(np.int32)}
+
+
+def _solver(tmp_prefix=None, **kw):
+    kw.setdefault("base_lr", 0.1)
+    kw.setdefault("lr_policy", "fixed")
+    kw.setdefault("momentum", 0.9)
+    kw.setdefault("random_seed", 7)
+    sp = make_sp(**kw)
+    if tmp_prefix:
+        sp.snapshot_prefix = tmp_prefix
+    return Solver(sp, net_param=_mlp_net(), log_fn=None)
+
+
+def _tree_equal(a, b):
+    for lname in a:
+        for i, x in enumerate(a[lname]):
+            np.testing.assert_array_equal(np.asarray(x),
+                                          np.asarray(b[lname][i]))
+
+
+# ---------------------------------------------------- atomic checkpoints ----
+
+class TestAtomicCheckpoint:
+    def test_manifest_commits_pair_with_checksums(self, tmp_path):
+        s = _solver()
+        data = _toy_batches(16)
+        for _ in range(3):
+            s.train_step(next(data))
+        prefix = str(tmp_path / "snap")
+        model, state = s.snapshot(prefix)
+        man = load_manifest(prefix)
+        assert man["latest"]["iter"] == 3
+        assert man["latest"]["model"] == os.path.basename(model)
+        assert man["latest"]["state"] == os.path.basename(state)
+        import hashlib
+        for k, p in (("model", model), ("state", state)):
+            want = man["latest"]["sha256"][k]
+            got = hashlib.sha256(open(p, "rb").read()).hexdigest()
+            assert got == want
+        # the commit protocol leaves no temp files behind
+        assert not [f for f in os.listdir(tmp_path) if ".tmp." in f]
+
+    @pytest.mark.parametrize("sfmt", [0, 1])  # HDF5 / binaryproto
+    def test_kill_resume_equivalence_bit_exact(self, sfmt, tmp_path):
+        """train N -> snapshot -> fresh solver -> restore -> M more steps
+        must equal an uninterrupted N+M run BIT-FOR-BIT: same program,
+        same inputs, and a float32 state that round-trips exactly."""
+        N, M = 5, 4
+        gen = _toy_batches(16)
+        batches = [next(gen) for _ in range(N + M)]
+
+        full = _solver(snapshot_format=sfmt)
+        for b in batches:
+            full.train_step(dict(b))
+
+        part = _solver(snapshot_format=sfmt)
+        for b in batches[:N]:
+            part.train_step(dict(b))
+        _, state_path = part.snapshot(str(tmp_path / "kr"))
+
+        res = _solver(snapshot_format=sfmt)     # the "fresh process"
+        res.restore(state_path)
+        assert res.iter == N
+        for b in batches[N:]:
+            res.train_step(dict(b))
+
+        assert res.iter == full.iter == N + M
+        _tree_equal(full.params, res.params)
+        for lname in full.history:
+            for i, slots in enumerate(full.history[lname]):
+                for si, x in enumerate(slots):
+                    np.testing.assert_array_equal(
+                        np.asarray(x),
+                        np.asarray(res.history[lname][i][si]))
+
+    def test_retention_keeps_newest_n(self, tmp_path):
+        s = _solver()
+        s.snapshot_keep = 2
+        prefix = str(tmp_path / "keep")
+        data = _toy_batches(16)
+        paths = []
+        for _ in range(4):
+            s.train_step(next(data))
+            paths.append(s.snapshot(prefix))
+        man = load_manifest(prefix)
+        assert [e["iter"] for e in man["snapshots"]] == [3, 4]
+        for model, state in paths[:2]:          # dropped from disk too
+            assert not os.path.exists(model) and not os.path.exists(state)
+        for model, state in paths[2:]:
+            assert os.path.exists(model) and os.path.exists(state)
+
+    def test_find_resumable_skips_corrupt_with_reason(self, tmp_path):
+        s = _solver()
+        prefix = str(tmp_path / "c")
+        data = _toy_batches(16)
+        s.train_step(next(data))
+        _, good_state = s.snapshot(prefix)
+        s.train_step(next(data))
+        _, bad_state = s.snapshot(prefix)
+        with open(bad_state, "r+b") as f:       # corrupt the newest state
+            f.seek(0)
+            f.write(b"\xde\xad\xbe\xef")
+        found, skipped = find_resumable(prefix)
+        assert found == good_state
+        assert len(skipped) == 1
+        assert skipped[0][0] == bad_state
+        assert "sha256" in skipped[0][1]
+        # an explicit restore of the corrupt one is refused, by name
+        s2 = _solver()
+        with pytest.raises(ValueError, match="refusing snapshot"):
+            s2.restore(bad_state)
+        s2.restore(good_state)                  # the good one still works
+        assert s2.iter == 1
+
+    def test_find_resumable_skips_missing_pair_and_tmp(self, tmp_path):
+        s = _solver()
+        prefix = str(tmp_path / "p")
+        data = _toy_batches(16)
+        s.train_step(next(data))
+        s.snapshot(prefix)
+        s.train_step(next(data))
+        model2, state2 = s.snapshot(prefix)
+        os.remove(model2)                       # crash "between the files"
+        # plus a torn temp from a dead writer
+        open(f"{prefix}_iter_9.solverstate.h5.tmp.999", "wb").close()
+        found, skipped = find_resumable(prefix)
+        assert found.endswith("_iter_1.solverstate")
+        assert any("missing" in r for _, r in skipped)
+
+    def test_find_resumable_legacy_unmanifested(self, tmp_path):
+        s = _solver()
+        data = _toy_batches(16)
+        for _ in range(2):
+            s.train_step(next(data))
+        prefix = str(tmp_path / "legacy")
+        model, state, fmt = s._snapshot_paths(prefix)
+        s._write_snapshot_files(model, state, fmt)      # no manifest
+        found, skipped = find_resumable(prefix)
+        assert found == state and not skipped
+
+    def test_resume_auto_fresh_start_when_nothing_there(self, tmp_path):
+        s = _solver()
+        assert resume_auto(s, str(tmp_path / "none")) is None
+        assert s.iter == 0
+
+
+# ------------------------------------------------------------- recovery ----
+
+class TestRecovery:
+    def test_chaos_nan_rolls_back_and_completes(self, tmp_path):
+        ml = MetricsLogger(str(tmp_path / "m.jsonl"))
+        s = _solver(display=1)
+        s.chaos = ChaosMonkey(nan_step=5, metrics=ml, log_fn=None)
+        pol = s.arm_recovery(max_rollbacks=2, metrics=ml)
+        s.step(12, _toy_batches(16))
+        ml.close()
+        # one poisoned step -> one rollback of one step -> 11 net iters
+        assert pol.rollbacks == 1
+        assert s.iter == 11
+        assert np.isfinite(s.smoothed_loss())
+        for plist in s.params.values():
+            for p in plist:
+                assert np.isfinite(np.asarray(p)).all()
+        events = [json.loads(l) for l in open(tmp_path / "m.jsonl")]
+        kinds = {(e["event"], e.get("kind")) for e in events}
+        assert ("chaos", "nan") in kinds
+        assert ("recovery", "rollback") in kinds
+
+    def test_persistent_divergence_aborts_cleanly(self):
+        s = _solver(display=1)
+        s.chaos = ChaosMonkey(nan_step=5, nan_repeat=True, log_fn=None)
+        s.arm_recovery(max_rollbacks=2)
+        with pytest.raises(RecoveryAbort, match="diverged"):
+            s.step(50, _toy_batches(16))
+
+    def test_lr_decay_applied_on_rollback(self):
+        s = _solver(display=1)
+        s.chaos = ChaosMonkey(nan_step=3, log_fn=None)
+        s.arm_recovery(max_rollbacks=2, lr_decay=0.5)
+        lr0 = float(s.lr_fn(0))
+        s.step(6, _toy_batches(16))
+        assert float(s.lr_fn(0)) == pytest.approx(lr0 * 0.5)
+
+    def test_reshuffle_hook_called(self):
+        calls = []
+        s = _solver(display=1)
+        s.chaos = ChaosMonkey(nan_step=3, log_fn=None)
+        s.arm_recovery(max_rollbacks=2, reshuffle=lambda: calls.append(1))
+        s.step(6, _toy_batches(16))
+        assert calls == [1]
+
+
+# ---------------------------------------------------------------- retry ----
+
+class TestRetry:
+    def test_backoff_then_success(self):
+        sleeps = []
+        pol = RetryPolicy(attempts=5, base_s=0.01, jitter=0.0,
+                          sleep=sleeps.append)
+        state = {"fails": 2}
+
+        def flaky():
+            if state["fails"] > 0:
+                state["fails"] -= 1
+                raise OSError("transient")
+            return "ok"
+
+        assert pol.call(flaky, where="t") == "ok"
+        assert sleeps == [0.01, 0.02]           # exponential, no jitter
+
+    def test_attempts_exhausted(self):
+        pol = RetryPolicy(attempts=2, sleep=lambda s: None)
+        with pytest.raises(RetryExhausted, match="attempts exhausted"):
+            pol.call(lambda: (_ for _ in ()).throw(OSError("dead")),
+                     where="t")
+
+    def test_lifetime_budget(self):
+        pol = RetryPolicy(attempts=10, budget=3, sleep=lambda s: None)
+
+        def always():
+            raise OSError("dead")
+
+        with pytest.raises(RetryExhausted, match="budget"):
+            pol.call(always, where="t")
+        assert pol.retries_used == 4            # 3 allowed + the fatal one
+
+    def test_db_source_survives_injected_io_errors(self, tmp_path):
+        from sparknet_tpu.data.lmdb import LMDBWriter
+        from sparknet_tpu.data.datum import array_to_datum
+        from sparknet_tpu.data.db_source import DatumBatchSource
+        rs = np.random.RandomState(0)
+        with LMDBWriter(str(tmp_path / "db")) as w:
+            for i in range(10):
+                img = rs.randint(0, 256, (3, 4, 4), np.uint8)
+                w.put(b"%05d" % i, array_to_datum(img, i))
+        src = DatumBatchSource(
+            str(tmp_path / "db"), batch_size=5, phase=0,
+            retry=RetryPolicy(attempts=6, sleep=lambda s: None, seed=0))
+        src._chaos = ChaosMonkey(io_p=0.2, seed=1, log_fn=None)
+        it = iter(src)
+        labels = []
+        for _ in range(4):                      # 2 full passes
+            labels.extend(next(it)["label"].tolist())
+        # retries must not skip or duplicate records: exact cursor order
+        assert labels == list(range(10)) * 2
+        assert src._chaos.injected > 0          # the path actually fired
+        src.close()
+
+    def test_db_source_retry_exhaustion_surfaces(self, tmp_path):
+        from sparknet_tpu.data.lmdb import LMDBWriter
+        from sparknet_tpu.data.datum import array_to_datum
+        from sparknet_tpu.data.db_source import DatumBatchSource
+        with LMDBWriter(str(tmp_path / "db")) as w:
+            w.put(b"0", array_to_datum(
+                np.zeros((1, 2, 2), np.uint8), 0))
+        src = DatumBatchSource(
+            str(tmp_path / "db"), batch_size=1, phase=0,
+            retry=RetryPolicy(attempts=2, sleep=lambda s: None))
+        src._chaos = ChaosMonkey(io_p=1.0, seed=1, log_fn=None)
+        with pytest.raises(RetryExhausted):
+            next(iter(src))
+        src.close()
+
+
+# ----------------------------------------------- signals, watchdog, run ----
+
+class TestSignalsAndRun:
+    def test_sigterm_snapshot_stop_action(self):
+        from sparknet_tpu.utils.signals import SignalPolicy
+        with SignalPolicy(sigterm="snapshot_stop") as p:
+            os.kill(os.getpid(), signal.SIGTERM)
+            assert p.pending() == "snapshot_stop"
+            assert p.pending() is None
+
+    def test_sigterm_none_leaves_default_handler(self):
+        from sparknet_tpu.utils.signals import SignalPolicy
+        before = signal.getsignal(signal.SIGTERM)
+        with SignalPolicy():
+            assert signal.getsignal(signal.SIGTERM) is before
+
+    def test_local_sgd_preempt_and_resume_auto(self, tmp_path):
+        from sparknet_tpu.parallel import LocalSGDSolver, make_mesh
+
+        def batch_fn(tau, seed=[0]):
+            # the net is compiled at PER-WORKER batch (16); the round
+            # feed carries the global batch = 2 workers x 16
+            rs = np.random.RandomState(seed[0])
+            seed[0] += 1
+            return {"data": rs.randn(tau, 32, 8).astype(np.float32),
+                    "label": rs.randint(0, 4, (tau, 32)).astype(np.int32)}
+
+        prefix = str(tmp_path / "lsgd" / "snap")
+        sp = dict(base_lr=0.05, lr_policy="fixed", random_seed=3)
+        s = LocalSGDSolver(make_sp(**sp), mesh=make_mesh({"data": 2}),
+                           tau=2, net_param=_mlp_net(), log_fn=None)
+        # the preemption notice arrives after round 2
+        s.chaos = ChaosMonkey(sigterm_round=2, log_fn=None)
+        s.run(6, batch_fn, snapshot_prefix=prefix)
+        assert s.iter == 4                      # stopped after 2 rounds
+        found, _ = find_resumable(prefix)
+        assert found is not None
+
+        # "relaunch": fresh solver, resume auto, continue
+        s2 = LocalSGDSolver(make_sp(**sp), mesh=make_mesh({"data": 2}),
+                            tau=2, net_param=_mlp_net(), log_fn=None)
+        s2.run(2, batch_fn, snapshot_prefix=prefix, resume="auto")
+        assert s2.iter == 8                     # 4 restored + 2 more rounds
+
+    def test_local_sgd_run_snapshot_every(self, tmp_path):
+        from sparknet_tpu.parallel import LocalSGDSolver, make_mesh
+
+        def batch_fn(tau):
+            rs = np.random.RandomState(0)
+            return {"data": rs.randn(tau, 32, 8).astype(np.float32),
+                    "label": rs.randint(0, 4, (tau, 32)).astype(np.int32)}
+
+        prefix = str(tmp_path / "se" / "snap")
+        s = LocalSGDSolver(make_sp(base_lr=0.05, lr_policy="fixed",
+                                   random_seed=3),
+                           mesh=make_mesh({"data": 2}), tau=2,
+                           net_param=_mlp_net(), log_fn=None)
+        s.run(4, batch_fn, snapshot_prefix=prefix, snapshot_every=2)
+        man = load_manifest(prefix)
+        assert [e["iter"] for e in man["snapshots"]] == [4, 8]
+
+    def test_watchdog_emergency_snapshot_before_exit(self, tmp_path):
+        from sparknet_tpu.utils.watchdog import Watchdog
+        calls, exits = [], []
+        ml = MetricsLogger(str(tmp_path / "wd.jsonl"))
+        wd = Watchdog(stall_seconds=0.1, poll_seconds=0.02,
+                      kill_on_stall=True, metrics=ml,
+                      on_stall=lambda dt: None,
+                      emergency_snapshot=lambda: calls.append(1) or "p",
+                      exit_fn=exits.append)
+        wd.start()
+        deadline = time.time() + 5.0
+        while not exits and time.time() < deadline:
+            time.sleep(0.02)
+        wd.stop()
+        assert exits and exits[0] == 42
+        assert calls == [1]
+        events = [json.loads(l) for l in open(tmp_path / "wd.jsonl")]
+        killed = [e for e in events if e.get("kind") == "killed"]
+        assert killed and killed[0]["emergency_snapshot_ok"] is True
+
+    def test_watchdog_emergency_snapshot_timeout(self, tmp_path):
+        from sparknet_tpu.utils.watchdog import Watchdog
+        exits = []
+        wd = Watchdog(stall_seconds=0.05, poll_seconds=0.02,
+                      kill_on_stall=True, on_stall=lambda dt: None,
+                      emergency_snapshot=lambda: time.sleep(60),
+                      emergency_timeout_s=0.1, exit_fn=exits.append)
+        wd.start()
+        deadline = time.time() + 5.0
+        while not exits and time.time() < deadline:
+            time.sleep(0.02)
+        wd.stop()
+        assert exits and exits[0] == 42         # a hung snapshot can't
+        #                                         block the exit
+
+
+# ---------------------------------------------------------------- chaos ----
+
+class TestChaos:
+    def test_parse_spec(self):
+        m = ChaosMonkey.parse("nan_step=30,io_p=0.05,stall_step=10,"
+                              "stall_s=2,sigterm_round=3,seed=1",
+                              log_fn=None)
+        assert m.nan_step == 30 and m.io_p == 0.05
+        assert m.stall_step == 10 and m.stall_s == 2.0
+        assert m.sigterm_round == 3
+
+    def test_parse_rejects_unknown_keys(self):
+        with pytest.raises(ValueError, match="unknown chaos keys"):
+            ChaosMonkey.parse("nan_stpe=30")
+
+    def test_poison_fires_once_unless_repeat(self):
+        m = ChaosMonkey(nan_step=3, log_fn=None)
+        assert not m.poison_loss(2)
+        assert m.poison_loss(3)
+        assert not m.poison_loss(4)
+        m = ChaosMonkey(nan_step=3, nan_repeat=True, log_fn=None)
+        assert m.poison_loss(3) and m.poison_loss(4)
+
+    def test_report_surfaces_resilience_events(self, tmp_path):
+        from sparknet_tpu.obs.report import aggregate, render
+        ml = MetricsLogger(str(tmp_path / "r.jsonl"))
+        s = _solver(display=1, tmp_prefix=str(tmp_path / "r" / "snap"))
+        s.metrics = ml
+        s.chaos = ChaosMonkey(nan_step=4, metrics=ml, log_fn=None)
+        s.arm_recovery(max_rollbacks=2, metrics=ml)
+        s.step(8, _toy_batches(16))
+        s.snapshot()
+        ml.close()
+        events = [json.loads(l) for l in open(tmp_path / "r.jsonl")]
+        rep = aggregate(events)
+        assert rep["recovery"]["kinds"]["rollback"] == 1
+        assert rep["chaos"]["nan"] == 1
+        assert rep["checkpoints"]["count"] == 1
+        text = render(rep)
+        assert "resilience" in text and "rollback" in text
