@@ -131,4 +131,37 @@ EOF
 python -m sparknet_tpu report "$tmp/chaos.jsonl" | grep "resilience" \
     > /dev/null
 
+# ----------------------------------------------------- health stage ----
+# Observability (ISSUE 3): a local-SGD run with a chaos stall pinned to
+# worker 1 must produce metrics from which `sparknet report` renders a
+# "training health" section with per-round divergence, the named
+# straggler, and at least one health alarm; `sparknet monitor --once`
+# must render the same stream; report/monitor on a missing file must be
+# a one-line error, exit 2.
+XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+python -m sparknet_tpu cifar --workers 2 --tau 3 --rounds 5 \
+    --test-every 100 --metrics "$tmp/health.jsonl" \
+    --chaos "stall_step=5,stall_s=3,stall_worker=1,stall_repeat=1" \
+    --health-straggler-factor 1.25 --health-cooldown 1 \
+    | tee "$tmp/health.out"
+
+python -m sparknet_tpu report "$tmp/health.jsonl" | tee "$tmp/health.rep"
+grep -q "training health" "$tmp/health.rep"
+grep -q "per-round mean divergence" "$tmp/health.rep"
+grep -q "straggler: worker 1" "$tmp/health.rep"
+grep -qE "health alarms: [1-9]" "$tmp/health.rep"
+python -m sparknet_tpu monitor "$tmp/health.jsonl" --once \
+    | grep -q "divergence: mean"
+echo "health stage OK: divergence measured, straggler named"
+
+if python -m sparknet_tpu report "$tmp/does-not-exist.jsonl" \
+    2> "$tmp/report.err"; then
+    echo "report on a missing file should exit non-zero"; exit 1
+fi
+test "$(wc -l < "$tmp/report.err")" -eq 1
+if python -m sparknet_tpu monitor "$tmp/does-not-exist.jsonl" --once \
+    2> /dev/null; then
+    echo "monitor on a missing file should exit non-zero"; exit 1
+fi
+
 echo "SMOKE OK"

@@ -194,7 +194,13 @@ class CifarApp:
                     loss = float(loss)
                     dt = time.perf_counter() - rt0
                     wd.beat(loss)
-                    self.log(f"round {r}: loss = {loss:.4f}")
+                    line = f"round {r}: loss = {loss:.4f}"
+                    d = getattr(self.solver, "last_divergence", None)
+                    if d and d.get("mean") is not None:
+                        # the paper's tau drift, measured at this round's
+                        # averaging step (obs/divergence.py)
+                        line += f", divergence = {d['mean']:.4g}"
+                    self.log(line)
                     if metrics:
                         metrics.log("round", round=r, loss=loss,
                                     iter=self.solver.iter,
@@ -204,6 +210,11 @@ class CifarApp:
                                                        / max(dt, 1e-9), 1))
         finally:
             batches.close()
+            h = getattr(self.solver, "health", None)
+            if h is not None and h.alarms:
+                s = h.summary()
+                self.log(f"health: {s['alarms']} alarm(s); last: "
+                         f"{s['last_alarm']}")
             self.solver.close()     # flush step/comms summaries
             if metrics:
                 metrics.close()

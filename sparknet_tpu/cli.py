@@ -157,10 +157,12 @@ def cmd_train(args):
         budget = float(os.environ.get("SPARKNET_DEVICE_CACHE_MB", "2048"))
         if budget > 0:
             isz = int(sp.iter_size)
-            train_src = maybe_device_cache(train_src, budget, iter_size=isz)
+            train_src = maybe_device_cache(train_src, budget, iter_size=isz,
+                                           metrics=metrics)
             if hasattr(train_src, "nbytes"):     # budget is SHARED
                 budget -= train_src.nbytes / (1 << 20)
-            test_src = maybe_device_cache(test_src, budget, iter_size=isz)
+            test_src = maybe_device_cache(test_src, budget, iter_size=isz,
+                                          metrics=metrics)
     feed = {**(train_shapes or {}), **_feed_shapes_arg(args.input_shape)}
 
     with tracer.span("setup", strategy=args.strategy):
@@ -195,6 +197,7 @@ def cmd_train(args):
         solver.arm_recovery(max_rollbacks=args.recover,
                             lr_decay=args.recover_lr_decay,
                             explode_factor=args.recover_explode_factor)
+    _apply_health_flags(solver, args)
     if args.weights:
         solver.load_weights(args.weights)
     if args.snapshot:
@@ -464,10 +467,19 @@ def cmd_time(args):
 
 def cmd_cifar(args):
     from .apps import CifarApp
+    if args.chaos:
+        # arm BEFORE app/solver construction so active_chaos() sees it
+        from .resilience.chaos import ChaosMonkey, install_chaos
+        install_chaos(ChaosMonkey.parse(args.chaos))
     app = CifarApp(num_workers=args.workers, data_dir=args.data,
                    prototxt_dir=args.prototxt_dir, strategy=args.strategy,
                    tau=args.tau, log_path=args.log,
                    metrics_path=args.metrics)
+    from .resilience.chaos import active_chaos
+    ch = active_chaos()
+    if ch is not None and ch.metrics is None and app.metrics is not None:
+        ch.metrics = app.metrics     # chaos events land in the run's JSONL
+    _apply_health_flags(app.solver, args)
     app.run(num_rounds=args.rounds, test_every=args.test_every)
     return 0
 
@@ -657,13 +669,86 @@ def cmd_lm(args):
 def cmd_report(args):
     """Aggregate a --metrics JSONL into a run report (sparknet_tpu.obs):
     per-phase time breakdown, step-time percentiles, comms volume,
-    recompile count, loss-curve summary — human-readable on stdout,
-    machine-readable with --json, Chrome trace_event spans with
-    --chrome."""
+    recompile count, training-health (divergence/stragglers/alarms),
+    loss-curve summary — human-readable on stdout, machine-readable with
+    --json, Chrome trace_event spans with --chrome."""
     from .obs import report as obs_report
-    obs_report.report_file(args.jsonl, json_out=args.json,
-                           chrome_out=args.chrome)
+    try:
+        obs_report.report_file(args.jsonl, json_out=args.json,
+                               chrome_out=args.chrome)
+    except obs_report.MetricsFileError as e:
+        # missing/empty/unreadable metrics is an operator error, not a
+        # crash: one line on stderr, distinct exit code
+        print(f"sparknet report: error: {e}", file=sys.stderr)
+        return 2
+    except BrokenPipeError:
+        # `sparknet report | head`: downstream closed the pipe mid-render
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        return 0
     return 0
+
+
+def cmd_monitor(args):
+    """Tail a --metrics JSONL and render a live terminal summary
+    (sparknet_tpu.obs.monitor): round/iter/loss, per-worker losses,
+    divergence, stragglers, memory, last health alarm."""
+    from .obs import monitor as obs_monitor
+    from .obs.report import MetricsFileError
+    try:
+        state = obs_monitor.monitor_file(
+            args.jsonl, interval=args.interval, once=args.once,
+            wait=args.wait, duration=args.duration)
+    except MetricsFileError as e:
+        print(f"sparknet monitor: error: {e}", file=sys.stderr)
+        return 2
+    except BrokenPipeError:
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        return 0
+    return 0 if state.events else 2
+
+
+def _add_health_flags(p):
+    """--health-* threshold flags shared by the training verbs; applied
+    via _apply_health_flags after the solver is built."""
+    p.add_argument("--no-health", action="store_true",
+                   help="disable the training-dynamics health detectors")
+    p.add_argument("--health-straggler-factor", type=float, default=1.5,
+                   help="flag a worker whose round latency exceeds this "
+                        "factor x the median of its peers")
+    p.add_argument("--health-loss-skew-factor", type=float, default=3.0,
+                   help="flag when the per-worker loss spread jumps past "
+                        "this factor x its rolling EMA")
+    p.add_argument("--health-div-abs", type=float, default=0.0,
+                   help=">0: critical alarm when mean worker divergence "
+                        "crosses this absolute L2 threshold")
+    p.add_argument("--health-trend-rounds", type=int, default=5,
+                   help="divergence-trend alarm window (consecutive "
+                        "growing observations)")
+    p.add_argument("--health-trend-factor", type=float, default=2.0,
+                   help="total growth over the trend window that "
+                        "triggers the divergence-trend alarm")
+    p.add_argument("--health-cooldown", type=int, default=5,
+                   help="min observations between same-kind alarms")
+    p.add_argument("--health-arm-recovery", action="store_true",
+                   help="critical health alarms arm the divergence "
+                        "RecoveryPolicy if none is armed yet")
+
+
+def _apply_health_flags(solver, args):
+    if getattr(solver, "metrics", None) is None or \
+            not hasattr(solver, "arm_health"):
+        return
+    if getattr(args, "no_health", False):
+        solver.arm_health(enabled=False)
+        return
+    solver.arm_health(
+        straggler_factor=args.health_straggler_factor,
+        loss_skew_factor=args.health_loss_skew_factor,
+        div_abs=args.health_div_abs,
+        trend_rounds=args.health_trend_rounds,
+        trend_factor=args.health_trend_factor,
+        cooldown=args.health_cooldown,
+        arm_recovery=args.health_arm_recovery)
 
 
 def cmd_imagenet(args):
@@ -751,6 +836,7 @@ def main(argv=None):
                         "'nan_step=30,io_p=0.02,sigterm_round=3,seed=1' "
                         "(also via SPARKNET_CHAOS; see "
                         "sparknet_tpu/resilience/chaos.py)")
+    _add_health_flags(t)
     t.set_defaults(fn=cmd_train)
 
     te = sub.add_parser("test", help="score a model")
@@ -853,6 +939,11 @@ def main(argv=None):
                    help="test every N rounds (CifarApp.scala:98)")
     c.add_argument("--log")
     c.add_argument("--metrics", help="JSONL metrics output path")
+    c.add_argument("--chaos", metavar="SPEC",
+                   help="deterministic fault injection (e.g. "
+                        "'stall_step=10,stall_s=2,stall_worker=1' to "
+                        "simulate a straggler; also via SPARKNET_CHAOS)")
+    _add_health_flags(c)
     c.set_defaults(fn=cmd_cifar)
 
     lm = sub.add_parser("lm", help="transformer-LM driver (synthetic "
@@ -905,6 +996,23 @@ def main(argv=None):
     rp.add_argument("--chrome", help="also export the run's spans as a "
                                      "Chrome trace_event file")
     rp.set_defaults(fn=cmd_report)
+
+    mo = sub.add_parser("monitor",
+                        help="tail a --metrics JSONL and render a live "
+                             "terminal summary (round/loss per worker, "
+                             "divergence, stragglers, memory, alarms)")
+    mo.add_argument("jsonl", help="metrics JSONL a run is writing "
+                                  "via --metrics")
+    mo.add_argument("--interval", type=float, default=1.0,
+                    help="refresh period in seconds")
+    mo.add_argument("--once", action="store_true",
+                    help="render the current state once and exit")
+    mo.add_argument("--wait", action="store_true",
+                    help="wait for the file to appear instead of erroring "
+                         "(a run that hasn't started writing yet)")
+    mo.add_argument("--duration", type=float, default=None,
+                    help="stop after this many seconds (default: forever)")
+    mo.set_defaults(fn=cmd_monitor)
 
     i = sub.add_parser("imagenet", help="ImageNetApp driver")
     i.add_argument("--workers", type=int, default=None)

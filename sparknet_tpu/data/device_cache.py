@@ -51,11 +51,18 @@ class DeviceCachedSource:
     host feeds nothing else (its check_batch override is None).
     """
 
-    def __init__(self, dbsource, device=None):
+    def __init__(self, dbsource, device=None, metrics=None, emit_every=100):
         import jax
         if not dbsource.device_mode:
             raise ValueError("DeviceCachedSource needs a device-mode source")
         self.inner = dbsource
+        # hit/miss gauge into the shared metrics stream (next to the
+        # prefetch queue gauges): every batch served from the resident
+        # arrays is a hit; misses only happen when promotion was refused
+        # (maybe_device_cache logs that refusal as an all-miss event)
+        self.metrics = metrics
+        self.emit_every = max(1, int(emit_every))
+        self.hits = 0
         self.source = dbsource.source
         self.batch_size = dbsource.batch_size
         self.data_top = dbsource.data_top
@@ -94,9 +101,19 @@ class DeviceCachedSource:
         self._labels = jax.device_put(labels, device)
         self._start = dbsource._skip % n
         dbsource.db.close()
+        self._gauge(resident=True)
+
+    def _gauge(self, **extra):
+        if self.metrics is None:
+            return
+        self.metrics.log("device_cache", source=self.source,
+                         records=self.num_records, nbytes=self.nbytes,
+                         hits=self.hits, misses=0, hit_rate=1.0, **extra)
 
     @property
     def nbytes(self):
+        if self._images is None:
+            return 0
         return self._images.nbytes + self._labels.nbytes
 
     @property
@@ -141,6 +158,9 @@ class DeviceCachedSource:
                 cols += [aux[ky], aux[kx]]
             if kf in aux:
                 cols.append(aux[kf].astype(np.int32))
+            self.hits += 1
+            if self.hits % self.emit_every == 0:
+                self._gauge()
             yield {self._ctl_key: np.stack(cols, axis=1),
                    self._img_key: self._images,
                    self._lab_key: self._labels}
@@ -191,10 +211,24 @@ class DeviceCachedSource:
         return over
 
     def close(self):
+        if self.hits % self.emit_every:
+            self._gauge()              # final partial-window gauge
         self._images = self._labels = None
 
 
-def maybe_device_cache(src, budget_mb=2048, iter_size=1):
+def _log_miss_mode(metrics, src, reason, **extra):
+    """Promotion refused: every batch will stream through the host — an
+    all-miss ``device_cache`` gauge with the reason, so a report can tell
+    'cache never engaged' apart from 'no gauge at all'."""
+    if metrics is None:
+        return
+    metrics.log("device_cache", source=getattr(src, "source", "?"),
+                resident=False, reason=reason, hits=0,
+                misses=getattr(src, "num_records", None), hit_rate=0.0,
+                **extra)
+
+
+def maybe_device_cache(src, budget_mb=2048, iter_size=1, metrics=None):
     """Promote a device-mode DatumBatchSource to a DeviceCachedSource when
     the whole dataset fits the HBM budget; otherwise return it unchanged
     (the streaming device-transform path still applies).
@@ -209,9 +243,11 @@ def maybe_device_cache(src, budget_mb=2048, iter_size=1):
     if not hasattr(src, "db"):
         return src
     if int(iter_size) > 1:
+        _log_miss_mode(metrics, src, "iter_size")
         return src
     import jax
     if jax.process_count() > 1:
+        _log_miss_mode(metrics, src, "multiprocess")
         return src
     # size from the first record's ACTUAL dtype — float_data datums decode
     # to float32, 4x the uint8 pixel estimate
@@ -223,5 +259,7 @@ def maybe_device_cache(src, budget_mb=2048, iter_size=1):
     # would have fit
     needed = est * 2 if est > _chunk_bytes() else est
     if needed > budget_mb * (1 << 20):
+        _log_miss_mode(metrics, src, "over_budget", est_bytes=est,
+                       budget_mb=budget_mb)
         return src
-    return DeviceCachedSource(src)
+    return DeviceCachedSource(src, metrics=metrics)

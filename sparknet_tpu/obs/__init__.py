@@ -13,8 +13,18 @@ perf PR reports against:
   comms.py      bytes moved per sync round (ring-allreduce cost model,
                 mapped to the paper's broadcast/collect model), plus
                 host->device feed byte counters
+  divergence.py per-sync-round worker-weight divergence measured
+                on-device before the averaging pmean (the paper's tau
+                drift, plus a gradient-noise-scale proxy)
+  health.py     rolling anomaly detectors over the round signals —
+                stragglers, loss skew, divergence trends — emitting
+                structured ``health`` alarms that can arm recovery
+  memstats.py   live-array/HBM/compile-cache/rss sampling so step-time
+                regressions decompose into recompile vs memory pressure
   report.py     `sparknet report`: aggregate a metrics JSONL into a
                 human-readable run report + machine-readable JSON
+  monitor.py    `sparknet monitor`: tail a live metrics JSONL and
+                render an in-place terminal summary of the run
 
 Everything writes through one utils.metrics.MetricsLogger, so a single
 JSONL stream carries spans, steps, comms, recompiles, watchdog barks,
@@ -25,10 +35,15 @@ from .trace import Tracer, JaxProfiler, chrome_from_spans, export_chrome
 from .stepstats import StepAccounting, percentiles, device_memory
 from .comms import (CommsMeter, tree_bytes, ring_allreduce_bytes,
                     broadcast_collect_bytes, all_to_all_bytes)
+from .divergence import DivergenceMeter, consensus_stats, tree_sq_dist
+from .health import HealthMonitor
+from .memstats import MemoryMonitor
 
 __all__ = [
     "Tracer", "JaxProfiler", "chrome_from_spans", "export_chrome",
     "StepAccounting", "percentiles", "device_memory",
     "CommsMeter", "tree_bytes", "ring_allreduce_bytes",
     "broadcast_collect_bytes", "all_to_all_bytes",
+    "DivergenceMeter", "consensus_stats", "tree_sq_dist",
+    "HealthMonitor", "MemoryMonitor",
 ]

@@ -36,9 +36,10 @@ def tree_bytes(tree):
 
 def ring_allreduce_bytes(nbytes, n):
     """Per-chip bytes for one ring allreduce (reduce-scatter+all-gather)
-    of ``nbytes`` over ``n`` peers."""
-    n = int(n)
-    if n <= 1:
+    of ``nbytes`` over ``n`` peers. world_size<=1 or an empty payload is
+    a no-op collective: 0 bytes, never negative/NaN."""
+    n, nbytes = int(n), int(nbytes)
+    if n <= 1 or nbytes <= 0:
         return 0
     return int(2 * (n - 1) / n * nbytes)
 
@@ -46,15 +47,19 @@ def ring_allreduce_bytes(nbytes, n):
 def broadcast_collect_bytes(nbytes, n):
     """The paper's driver-centric sync cost: broadcast N copies out plus
     collect N copies back through one driver (SparkNet's per-round
-    weight movement, CifarApp.scala:92-135)."""
-    return int(2 * int(n) * nbytes)
+    weight movement, CifarApp.scala:92-135). A single worker IS the
+    driver — nothing moves — and an empty payload moves nothing."""
+    n, nbytes = int(n), int(nbytes)
+    if n <= 1 or nbytes <= 0:
+        return 0
+    return int(2 * n * nbytes)
 
 
 def all_to_all_bytes(nbytes, n):
     """Per-chip bytes for one all_to_all of a ``nbytes`` local buffer:
     (n-1)/n of it leaves the chip (the diagonal block stays)."""
-    n = int(n)
-    if n <= 1:
+    n, nbytes = int(n), int(nbytes)
+    if n <= 1 or nbytes <= 0:
         return 0
     return int((n - 1) / n * nbytes)
 
@@ -81,9 +86,14 @@ class CommsMeter:
                  note=None, **extra):
         """Declare a collective the compiled step performs: per-chip
         ``bytes_per_round`` every ``steps_per_round`` steps (tau for
-        local SGD, 1 for per-step DP)."""
+        local SGD, 1 for per-step DP). A zero-byte collective (world
+        size 1, empty payload) is a no-op: nothing is registered —
+        0 bytes, 0 rounds — so single-worker runs never report phantom
+        (or negative) collective traffic."""
+        if int(bytes_per_round) <= 0:
+            return None
         c = {"kind": kind, "bytes_per_round": int(bytes_per_round),
-             "steps_per_round": int(steps_per_round)}
+             "steps_per_round": max(1, int(steps_per_round))}
         if axis is not None:
             c["axis"] = axis
         if note:

@@ -1,0 +1,182 @@
+"""Worker-weight divergence: the paper's tau knob, measured.
+
+SparkNet's central tradeoff is sync interval tau: more local steps per
+round cut communication but let per-worker replicas drift apart before
+the average (PAPER.md; Stich's local-SGD analysis bounds exactly this
+drift term). The repo could *set* tau but never *see* the drift — this
+module measures it where it is cheap: INSIDE the compiled sync round,
+before the averaging pmean, so the cost is one elementwise pass over the
+tree plus a handful of scalar collectives, never a host gather of
+weights.
+
+Two halves:
+
+  consensus_stats / tree_sq_dist   pure jnp, called inside shard_map by
+      the sharded solvers: average the tree across the axis (the sync
+      the solver was doing anyway), then measure each worker's squared
+      L2 distance to that consensus — total, per top-level key (layer),
+      per worker (an all_gather of ONE scalar each).
+  DivergenceMeter   host side: takes the fetched aux dict once per
+      sampled round, emits a ``divergence`` JSONL event (mean/max/
+      per-worker distance, top offender layers, update norm, a
+      gradient-noise-scale proxy) and returns the summary for the
+      health detectors (obs/health.py).
+
+The gradient-noise-scale proxy follows McCandlish et al.'s B_simple
+estimator shape: with N workers' updates u_w around consensus u,
+``gns_proxy = N/(N-1) * E||u_w - u||^2 / ||u||^2`` — the between-worker
+update variance in units of the squared mean update. It is a *proxy*
+(per-worker updates are tau-step paths, not single gradients); its value
+is the trend: rising means the per-round average is absorbing more noise
+relative to signal, i.e. tau (or lr) is too large for this phase of
+training.
+"""
+
+import math
+
+import numpy as np
+
+
+def _sq_sum(tree):
+    """Sum of squares over every leaf, accumulated in f32."""
+    import jax
+    import jax.numpy as jnp
+    leaves = jax.tree_util.tree_leaves(tree)
+    if not leaves:
+        return jnp.float32(0)
+    total = jnp.float32(0)
+    for leaf in leaves:
+        total = total + jnp.sum(
+            jnp.square(jnp.asarray(leaf, jnp.float32)))
+    return total
+
+
+def tree_sq_dist(a, b):
+    """Squared L2 distance between two same-structure trees, grouped by
+    top-level key (the per-layer param dict) -> ({key: sq}, total_sq).
+    Non-dict trees are treated as one group named "all"."""
+    import jax
+    import jax.numpy as jnp
+
+    def diff(x, y):
+        return jnp.asarray(x, jnp.float32) - jnp.asarray(y, jnp.float32)
+
+    if not isinstance(a, dict):
+        s = _sq_sum(jax.tree_util.tree_map(diff, a, b))
+        return {"all": s}, s
+    per, total = {}, None
+    for k in a:
+        s = _sq_sum(jax.tree_util.tree_map(diff, a[k], b[k]))
+        per[k] = s
+        total = s if total is None else total + s
+    if total is None:
+        total = jnp.float32(0)
+    return per, total
+
+
+def consensus_stats(tree, axis):
+    """INSIDE shard_map over ``axis``: average ``tree`` across workers
+    and measure each worker's drift from that consensus.
+
+    Returns (consensus, aux) where consensus == pmean(tree, axis) — the
+    sync the caller was going to do anyway, so the extra cost is the
+    squared-distance pass plus scalar collectives — and aux holds
+    replicated f32 scalars/vectors safe for a P() out_spec:
+
+      div_mean_sq    E_w ||tree_w - consensus||^2
+      div_max_sq     max_w ...
+      div_worker_sq  (N,) all_gather of each worker's squared distance
+      layer_div_sq   {layer: E_w per-layer squared distance}
+    """
+    import jax
+    consensus = jax.lax.pmean(tree, axis)
+    per_layer, local_sq = tree_sq_dist(tree, consensus)
+    aux = {
+        "div_mean_sq": jax.lax.pmean(local_sq, axis),
+        "div_max_sq": jax.lax.pmax(local_sq, axis),
+        "div_worker_sq": jax.lax.all_gather(local_sq, axis),
+        "layer_div_sq": {k: jax.lax.pmean(v, axis)
+                         for k, v in per_layer.items()},
+    }
+    return consensus, aux
+
+
+def gather_worker_scalar(x, axis):
+    """all_gather one replicated-output scalar per worker along ``axis``
+    (inside shard_map) — the per-worker loss vector costs N floats."""
+    import jax
+    import jax.numpy as jnp
+    return jax.lax.all_gather(jnp.asarray(x, jnp.float32), axis)
+
+
+class DivergenceMeter:
+    """Host side: turn one sync round's fetched aux dict into a
+    ``divergence`` event + a plain-float summary for the detectors.
+
+    kind: what the distances are over — "params" (local SGD: tau-step
+    weight drift) or "grads" (per-step DP: gradient noise across the
+    batch shards). ``ref_sq`` in the aux is the squared norm of the
+    consensus update (local SGD) or mean gradient (DP) — the
+    denominator of the relative drift and the GNS proxy.
+    """
+
+    def __init__(self, sink, topk=3):
+        self.sink = sink
+        self.topk = max(1, int(topk))
+        self.last = None
+        self.samples = 0
+
+    @staticmethod
+    def _f(v):
+        try:
+            return float(np.asarray(v))
+        except Exception:
+            return None
+
+    def observe(self, it, aux, kind="params", tau=None, round_idx=None,
+                emit=True):
+        """aux: host-fetched dict from consensus_stats (plus optional
+        ref_sq / worker_loss). Returns the summary dict (floats), or
+        None when aux carries no divergence fields."""
+        if not aux or "div_mean_sq" not in aux:
+            return None
+        mean_sq = self._f(aux["div_mean_sq"]) or 0.0
+        max_sq = self._f(aux.get("div_max_sq")) or 0.0
+        ev = {"iter": it, "kind": kind,
+              "mean": round(math.sqrt(max(mean_sq, 0.0)), 8),
+              "max": round(math.sqrt(max(max_sq, 0.0)), 8)}
+        if tau is not None:
+            ev["tau"] = int(tau)
+        if round_idx is not None:
+            ev["round"] = int(round_idx)
+        workers = aux.get("div_worker_sq")
+        if workers is not None:
+            w = np.sqrt(np.maximum(
+                np.asarray(workers, np.float64).ravel(), 0.0))
+            ev["per_worker"] = [round(float(x), 8) for x in w]
+        layers = aux.get("layer_div_sq") or {}
+        ranked = sorted(((k, self._f(v) or 0.0) for k, v in layers.items()),
+                        key=lambda kv: -kv[1])
+        if ranked:
+            ev["top_layers"] = [
+                [k, round(math.sqrt(max(v, 0.0)), 8)]
+                for k, v in ranked[:self.topk] if v > 0.0] or \
+                [[ranked[0][0], 0.0]]
+        ref_sq = self._f(aux.get("ref_sq"))
+        if ref_sq is not None:
+            ev["update_norm"] = round(math.sqrt(max(ref_sq, 0.0)), 8)
+            denom = max(ref_sq, 1e-20)
+            ev["rel"] = round(math.sqrt(max(mean_sq, 0.0) / denom), 6)
+            n = len(ev.get("per_worker", ())) or 0
+            if n > 1:
+                ev["gns_proxy"] = round(
+                    n / (n - 1) * mean_sq / denom, 6)
+        wl = aux.get("worker_loss")
+        if wl is not None:
+            wl = np.asarray(wl, np.float64).ravel()
+            ev["worker_loss"] = [round(float(x), 6) for x in wl]
+        self.samples += 1
+        self.last = ev
+        if emit and self.sink is not None:
+            self.sink.log("divergence", **ev)
+        return ev

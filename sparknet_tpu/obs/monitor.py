@@ -1,0 +1,283 @@
+"""`sparknet monitor` — live terminal view of a training run.
+
+`sparknet report` is a post-mortem; this is the in-flight view. It tails
+the metrics JSONL a run writes via --metrics (the same single stream the
+whole obs subsystem shares) and renders a compact summary that refreshes
+in place: current round/iter and loss, per-worker losses, worker
+divergence with top offender layers, straggler flags, memory/compile
+state, and the last health alarm. Pure file tailing — no jax imports, no
+connection to the training process — so it works over any shared
+filesystem, from any machine, against a live or finished run.
+
+Partial trailing lines (the run is mid-write) are buffered until their
+newline arrives; malformed lines are counted and skipped, never fatal.
+"""
+
+import collections
+import json
+import os
+import sys
+import time
+
+from .report import MetricsFileError, _fmt_bytes, _num
+
+
+class MonitorState:
+    """Fold metrics events into the "now" view of a run."""
+
+    def __init__(self):
+        self.events = 0
+        self.bad_lines = 0
+        self.by_type = collections.Counter()
+        self.iter = None
+        self.round = None
+        self.loss = None
+        self.min_loss = None
+        self.lr = None
+        self.rate = None            # (name, value)
+        self.step = None            # last step event
+        self.worker_loss = None
+        self.divergence = None      # last divergence event
+        self.memstats = None
+        self.comms = None
+        self.alarms = collections.Counter()
+        self.last_alarm = None
+        self.straggler_counts = collections.Counter()
+        self.recompiles = 0
+        self.recoveries = 0
+        self.chaos = 0
+        self.checkpoint_iter = None
+        self.done = None            # summary event, if the run finished
+
+    def update(self, ev):
+        self.events += 1
+        kind = ev.get("event", "?")
+        self.by_type[kind] += 1
+        if kind in ("train", "round"):
+            if _num(ev.get("iter")):
+                self.iter = ev["iter"]
+            if _num(ev.get("round")):
+                self.round = ev["round"]
+            if _num(ev.get("loss")):
+                self.loss = ev["loss"]
+                self.min_loss = ev["loss"] if self.min_loss is None \
+                    else min(self.min_loss, ev["loss"])
+            if _num(ev.get("lr")):
+                self.lr = ev["lr"]
+            for r in ("images_per_sec", "tokens_per_sec", "images_per_s"):
+                if _num(ev.get(r)):
+                    self.rate = (r, ev[r])
+        elif kind == "step":
+            self.step = ev
+            if _num(ev.get("iter")):
+                self.iter = max(self.iter or 0, ev["iter"])
+        elif kind == "divergence":
+            self.divergence = ev
+            if ev.get("worker_loss"):
+                self.worker_loss = ev["worker_loss"]
+            if _num(ev.get("round")):
+                self.round = ev["round"]
+        elif kind == "health":
+            k = ev.get("kind", "?")
+            self.alarms[k] += 1
+            self.last_alarm = ev
+            if k == "straggler" and ev.get("worker") is not None:
+                self.straggler_counts[ev["worker"]] += 1
+        elif kind == "memstats":
+            self.memstats = ev
+        elif kind == "comms":
+            self.comms = ev
+        elif kind == "recompile":
+            if not ev.get("first"):
+                self.recompiles += 1
+        elif kind == "recovery":
+            self.recoveries += 1
+        elif kind == "chaos":
+            self.chaos += 1
+        elif kind == "checkpoint":
+            if _num(ev.get("iter")):
+                self.checkpoint_iter = ev["iter"]
+        elif kind == "summary":
+            self.done = ev
+
+    # -- rendering ---------------------------------------------------------
+    @staticmethod
+    def _fmt_workers(vals, fmt="{:.4g}"):
+        return "[" + " ".join(fmt.format(v) for v in vals) + "]"
+
+    def render(self, path=""):
+        L = []
+        status = "FINISHED" if self.done else "live"
+        L.append(f"sparknet monitor — {path} ({self.events} events, "
+                 f"{self.bad_lines} bad lines, {status})")
+        pos = []
+        if self.round is not None:
+            pos.append(f"round {self.round}")
+        if self.iter is not None:
+            pos.append(f"iter {self.iter}")
+        if self.loss is not None:
+            pos.append(f"loss {self.loss:.6g}"
+                       + (f" (min {self.min_loss:.6g})"
+                          if self.min_loss is not None else ""))
+        if self.lr is not None:
+            pos.append(f"lr {self.lr:.4g}")
+        if self.rate:
+            pos.append(f"{self.rate[0]} {self.rate[1]:,.0f}")
+        if pos:
+            L.append("  " + "  ".join(pos))
+        if self.step:
+            bits = [f"host {self.step.get('host_ms', '?')} ms",
+                    f"device {self.step.get('device_ms', '?')} ms"]
+            if self.recompiles:
+                bits.append(f"recompiles {self.recompiles}")
+            L.append("  step: " + "  ".join(bits))
+        if self.worker_loss:
+            L.append("  workers: loss " + self._fmt_workers(self.worker_loss)
+                     + f"  skew {max(self.worker_loss) - min(self.worker_loss):.4g}")
+        d = self.divergence
+        if d:
+            line = f"  divergence: mean {d.get('mean', 0):.4g} " \
+                   f"max {d.get('max', 0):.4g}"
+            if _num(d.get("rel")):
+                line += f"  rel {d['rel']:.3g}"
+            if _num(d.get("gns_proxy")):
+                line += f"  gns~{d['gns_proxy']:.3g}"
+            if d.get("tau"):
+                line += f"  tau={d['tau']}"
+            L.append(line)
+            if d.get("top_layers"):
+                L.append("    top layers: " + ", ".join(
+                    f"{k}={v:.3g}" for k, v in d["top_layers"]))
+        if self.straggler_counts:
+            worst = self.straggler_counts.most_common(1)[0]
+            L.append(f"  stragglers: worker {worst[0]} flagged "
+                     f"{worst[1]}x" + (
+                         "  (others: " + ", ".join(
+                             f"w{w}:{c}" for w, c in
+                             self.straggler_counts.most_common()[1:]) + ")"
+                         if len(self.straggler_counts) > 1 else ""))
+        m = self.memstats
+        if m:
+            bits = []
+            if _num(m.get("live_bytes")):
+                bits.append(f"live {_fmt_bytes(m['live_bytes'])} "
+                            f"({m.get('live_arrays', '?')} arrays)")
+            if _num(m.get("hbm_peak_bytes_in_use")):
+                bits.append(
+                    f"hbm peak {_fmt_bytes(m['hbm_peak_bytes_in_use'])}")
+            if _num(m.get("compile_cache")):
+                bits.append(f"compile cache {m['compile_cache']}")
+            if _num(m.get("host_rss_bytes")):
+                bits.append(f"rss {_fmt_bytes(m['host_rss_bytes'])}")
+            if bits:
+                L.append("  memory: " + "  ".join(bits))
+        if self.comms and _num(self.comms.get("collective_bytes_per_step")):
+            L.append("  comms: "
+                     f"{_fmt_bytes(self.comms['collective_bytes_per_step'])}"
+                     "/step collective, h2d total "
+                     f"{_fmt_bytes(self.comms.get('h2d_bytes_total'))}")
+        extras = []
+        if self.recoveries:
+            extras.append(f"recoveries {self.recoveries}")
+        if self.chaos:
+            extras.append(f"chaos injections {self.chaos}")
+        if self.checkpoint_iter is not None:
+            extras.append(f"last checkpoint iter {self.checkpoint_iter}")
+        if extras:
+            L.append("  " + "  ".join(extras))
+        if self.alarms:
+            L.append("  alarms: " + ", ".join(
+                f"{k}: {v}" for k, v in sorted(self.alarms.items())))
+        a = self.last_alarm
+        if a:
+            detail = " ".join(f"{k}={v}" for k, v in a.items()
+                              if k not in ("event", "t", "kind", "severity"))
+            L.append(f"  last alarm: [{a.get('kind')}] {detail}")
+        elif self.by_type.get("health") == 0 or not self.alarms:
+            L.append("  no health alarms")
+        return "\n".join(L)
+
+
+class _Tail:
+    """Incremental JSONL reader: returns complete new lines per poll,
+    buffers a partial trailing line, survives truncation by reopening."""
+
+    def __init__(self, path):
+        self.path = path
+        self.pos = 0
+        self.buf = ""
+
+    def poll(self):
+        try:
+            size = os.path.getsize(self.path)
+        except OSError:
+            return []
+        if size < self.pos:            # truncated/rotated: start over
+            self.pos, self.buf = 0, ""
+        if size == self.pos:
+            return []
+        with open(self.path, "r", errors="replace") as f:
+            f.seek(self.pos)
+            chunk = f.read()
+            self.pos = f.tell()
+        self.buf += chunk
+        lines = self.buf.split("\n")
+        self.buf = lines.pop()         # '' after a complete final line
+        return lines
+
+
+def monitor_file(path, interval=1.0, once=False, wait=False,
+                 duration=None, out=None, clear=None):
+    """Tail ``path`` and render the live summary every ``interval``
+    seconds. once=True renders the current state and returns. wait=True
+    blocks for the file to appear (a run that hasn't started writing
+    yet) instead of erroring. Returns the final MonitorState."""
+    write = out or (lambda s: print(s, flush=True))
+    t0 = time.time()
+    while not os.path.exists(path):
+        if not wait:
+            raise MetricsFileError(f"metrics file not found: {path}")
+        if duration is not None and time.time() - t0 > duration:
+            raise MetricsFileError(
+                f"metrics file never appeared: {path}")
+        time.sleep(min(interval, 0.5))
+    tail = _Tail(path)
+    state = MonitorState()
+    if clear is None:
+        clear = sys.stdout.isatty()
+
+    def ingest():
+        got = False
+        for line in tail.poll():
+            line = line.strip()
+            if not line:
+                continue
+            got = True
+            try:
+                ev = json.loads(line)
+            except ValueError:
+                state.bad_lines += 1
+                continue
+            if isinstance(ev, dict):
+                state.update(ev)
+            else:
+                state.bad_lines += 1
+        return got
+
+    ingest()
+    if once:
+        if state.events == 0 and state.bad_lines == 0:
+            raise MetricsFileError(f"metrics file is empty: {path}")
+        write(state.render(path))
+        return state
+    try:
+        while True:
+            write(("\x1b[2J\x1b[H" if clear else "")
+                  + state.render(path) + ("" if clear else "\n"))
+            if duration is not None and time.time() - t0 >= duration:
+                break
+            time.sleep(interval)
+            ingest()
+    except KeyboardInterrupt:
+        pass
+    return state

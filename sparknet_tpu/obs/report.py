@@ -17,10 +17,22 @@ import json
 from .stepstats import percentiles
 
 
+class MetricsFileError(RuntimeError):
+    """A metrics JSONL that can't be reported on (missing/unreadable/
+    empty) — the CLI turns this into a one-line error, not a traceback."""
+
+
 def load_events(path):
-    """Parse a JSONL file -> (events, malformed_line_count)."""
+    """Parse a JSONL file -> (events, malformed_line_count). Bad lines
+    (truncated writes, garbage) are skipped and counted, never fatal.
+    Raises MetricsFileError when the file itself can't be read."""
     events, bad = [], 0
-    with open(path) as f:
+    try:
+        f = open(path, errors="replace")
+    except OSError as e:
+        raise MetricsFileError(
+            f"cannot read metrics file {path}: {e.strerror or e}")
+    with f:
         for line in f:
             line = line.strip()
             if not line:
@@ -174,6 +186,74 @@ def aggregate(events):
             c["resume_refused"] = resumes[-1].get("refused")
         rep["checkpoints"] = c
 
+    # -- training health (obs divergence/health/memstats) ------------------
+    div = [e for e in events if e.get("event") == "divergence"]
+    if div:
+        means = [e["mean"] for e in div if _num(e.get("mean"))]
+        d = {"samples": len(div)}
+        if means:
+            d.update(first_mean=means[0], last_mean=means[-1],
+                     peak_mean=max(means))
+            if means[0] > 0:
+                d["trend"] = round(means[-1] / means[0], 3)
+        maxes = [e["max"] for e in div if _num(e.get("max"))]
+        if maxes:
+            d["peak_worker"] = max(maxes)
+        last = div[-1]
+        for k in ("kind", "tau", "rel", "gns_proxy", "update_norm",
+                  "top_layers"):
+            if last.get(k) is not None:
+                d[k] = last[k]
+        # the per-round curve itself (capped): iter -> mean divergence
+        pts = [(e.get("round", e.get("iter")), e.get("mean"))
+               for e in div if _num(e.get("mean"))]
+        d["per_round"] = [[r, m] for r, m in pts[-50:]]
+        rep["divergence"] = d
+    hl = [e for e in events if e.get("event") == "health"]
+    if hl:
+        h = {"alarms": len(hl),
+             "by_kind": dict(collections.Counter(
+                 e.get("kind", "?") for e in hl))}
+        stragglers = collections.Counter(
+            e.get("worker") for e in hl
+            if e.get("kind") == "straggler" and e.get("worker") is not None)
+        if stragglers:
+            h["stragglers_by_worker"] = {str(k): v
+                                         for k, v in stragglers.items()}
+            h["worst_straggler"] = stragglers.most_common(1)[0][0]
+        last = hl[-1]
+        h["last_alarm"] = {k: v for k, v in last.items()
+                           if k not in ("event", "t", "run")}
+        taus = [e["suggest_tau"] for e in hl if _num(e.get("suggest_tau"))]
+        if taus:
+            h["suggest_tau"] = taus[-1]
+        rep["health"] = h
+    mem = [e for e in events if e.get("event") == "memstats"]
+    if mem:
+        m = {"samples": len(mem)}
+        live = [e["live_bytes"] for e in mem if _num(e.get("live_bytes"))]
+        if live:
+            m["live_bytes_last"] = live[-1]
+            m["live_bytes_peak"] = max(live)
+        caches = [e["compile_cache"] for e in mem
+                  if _num(e.get("compile_cache"))]
+        if caches:
+            m["compile_cache_last"] = caches[-1]
+        rss = [e["host_rss_bytes"] for e in mem
+               if _num(e.get("host_rss_bytes"))]
+        if rss:
+            m["host_rss_peak"] = max(rss)
+        hbm_keys = [e["hbm_peak_bytes_in_use"] for e in mem
+                    if _num(e.get("hbm_peak_bytes_in_use"))]
+        if hbm_keys:
+            m["hbm_peak_bytes_in_use"] = max(hbm_keys)
+        rep["memstats"] = m
+    dc = [e for e in events if e.get("event") == "device_cache"]
+    if dc:
+        last = dc[-1]
+        rep["device_cache"] = {k: v for k, v in last.items()
+                               if k not in ("event", "t", "run")}
+
     # -- auxiliary streams -------------------------------------------------
     wd = [e for e in events if e.get("event") == "watchdog"]
     if wd:
@@ -321,6 +401,72 @@ def render(rep):
         if rt:
             L.append(f"  io retries: {rt['count']} "
                      f"({rt['exhausted']} exhausted)")
+    if any(rep.get(k) for k in ("divergence", "health", "memstats")):
+        hdr("training health")
+        d = rep.get("divergence")
+        if d:
+            line = f"  divergence ({d.get('kind', 'params')}): " \
+                   f"mean {d.get('first_mean', '?')} -> " \
+                   f"{d.get('last_mean', '?')} " \
+                   f"(peak {d.get('peak_mean', '?')}, " \
+                   f"{d.get('samples')} samples"
+            if _num(d.get("trend")):
+                line += f", trend x{d['trend']}"
+            if d.get("tau"):
+                line += f", tau={d['tau']}"
+            line += ")"
+            L.append(line)
+            if _num(d.get("rel")) or _num(d.get("gns_proxy")):
+                bits = []
+                if _num(d.get("rel")):
+                    bits.append(f"drift/update ratio {d['rel']}")
+                if _num(d.get("gns_proxy")):
+                    bits.append(f"grad-noise-scale proxy {d['gns_proxy']}")
+                L.append("    " + ", ".join(bits))
+            if d.get("top_layers"):
+                L.append("    top drifting layers: " + ", ".join(
+                    f"{k}={v:.3g}" for k, v in d["top_layers"]))
+            pr = d.get("per_round") or []
+            if pr:
+                L.append("    per-round mean divergence (last "
+                         f"{len(pr[-8:])}): " + ", ".join(
+                             f"{r}:{m:.3g}" for r, m in pr[-8:]))
+        h = rep.get("health")
+        if h:
+            L.append(f"  health alarms: {h.get('alarms', 0)} (" + ", ".join(
+                f"{k}: {v}" for k, v in sorted(
+                    h.get("by_kind", {}).items())) + ")")
+            if h.get("stragglers_by_worker"):
+                L.append("    straggler: worker "
+                         f"{h['worst_straggler']} flagged "
+                         f"{h['stragglers_by_worker'][str(h['worst_straggler'])]}x "
+                         f"(all: {h['stragglers_by_worker']})")
+            la = h.get("last_alarm")
+            if la:
+                detail = " ".join(f"{k}={v}" for k, v in la.items()
+                                  if k != "kind")
+                L.append(f"    last alarm: [{la.get('kind')}] {detail}")
+            if _num(h.get("suggest_tau")):
+                L.append(f"    suggested tau: {h['suggest_tau']}")
+        m = rep.get("memstats")
+        if m:
+            bits = [f"{m.get('samples')} samples"]
+            if _num(m.get("live_bytes_peak")):
+                bits.append(f"peak live arrays "
+                            f"{_fmt_bytes(m['live_bytes_peak'])}")
+            if _num(m.get("hbm_peak_bytes_in_use")):
+                bits.append(f"hbm peak "
+                            f"{_fmt_bytes(m['hbm_peak_bytes_in_use'])}")
+            if _num(m.get("compile_cache_last")):
+                bits.append(f"compile cache {m['compile_cache_last']}")
+            if _num(m.get("host_rss_peak")):
+                bits.append(f"host rss peak "
+                            f"{_fmt_bytes(m['host_rss_peak'])}")
+            L.append("  memory: " + ", ".join(bits))
+    if rep.get("device_cache"):
+        hdr("device cache (last gauge)")
+        for k, v in sorted(rep["device_cache"].items()):
+            L.append(f"  {k} = {v}")
     if rep.get("watchdog"):
         hdr("watchdog")
         for k, v in sorted(rep["watchdog"].items()):
@@ -350,6 +496,11 @@ def report_file(jsonl_path, json_out=None, chrome_out=None, out=print):
     """Load + aggregate + render; optionally write JSON / Chrome trace.
     The implementation behind `sparknet report`."""
     events, bad = load_events(jsonl_path)
+    if not events:
+        raise MetricsFileError(
+            f"metrics file has no parseable events: {jsonl_path}"
+            + (f" ({bad} malformed line(s) skipped)" if bad
+               else " (file is empty)"))
     rep = aggregate(events)
     if bad:
         rep["malformed_lines"] = bad

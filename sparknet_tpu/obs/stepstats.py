@@ -125,7 +125,9 @@ class StepAccounting:
                 sample=None):
         """Record one step. host_s: dispatch wall seconds. result: the
         step's output (blocked on at sample points). sample: None for the
-        automatic cadence, True/False to force."""
+        automatic cadence, True/False to force. Returns True when this
+        step was sampled (i.e. the host already paid the device sync) —
+        callers piggyback other fetch-costly sampling on it."""
         self.steps += 1
         self._push_host(host_s)
         self._check_recompile(it, jit_fn, batch)
@@ -134,7 +136,7 @@ class StepAccounting:
                 or (it - self._last_sample_it) >= self.sample_every
         self._nobs += 1
         if not sample or result is None:
-            return
+            return False
         t0 = time.perf_counter()
         try:
             import jax
@@ -167,6 +169,7 @@ class StepAccounting:
                 self._hbm_dead = True       # CPU: don't re-probe per sample
             else:
                 self.sink.log("hbm", iter=it, **mem)
+        return True
 
     def summary(self):
         host = percentiles([v * 1e3 for v in self.host_s])

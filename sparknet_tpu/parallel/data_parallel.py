@@ -23,6 +23,8 @@ import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..solver.solver import Solver
+from ..obs.divergence import (consensus_stats, tree_sq_dist, _sq_sum,
+                              gather_worker_scalar)
 from .mesh import DATA_AXIS
 from . import context
 from .compat import shard_map
@@ -204,6 +206,11 @@ class DataParallelSolver(Solver):
         iter_size = int(self.param.iter_size)
         net, updater, lr_fn = self.local_net, self.updater, self.lr_fn
         axis = self.axis
+        # metrics on -> also measure per-worker gradient divergence around
+        # the averaging pmean (obs/divergence.py): the between-shard
+        # gradient noise, per layer, plus the per-worker loss vector —
+        # all replicated scalars, fetched only at step-sample points
+        with_stats = self.stepstats is not None
         loss_fn = self._wrapped_loss(net)   # device-side input transform
         # (shape-polymorphic vmap, so the global-net transform applies
         # unchanged to each shard's slice)
@@ -232,13 +239,21 @@ class DataParallelSolver(Solver):
                 (grads, state, _), losses = jax.lax.scan(
                     body, (zero, state, 0), batch)
                 loss = jnp.mean(losses)
-            # THE collective: replaces P2PSync's up-tree gradient sum
-            grads = jax.lax.pmean(grads, axis)
+            # THE collective: replaces P2PSync's up-tree gradient sum —
+            # with stats on, consensus_stats does the same pmean and also
+            # measures each shard's drift from it (the gradient noise)
+            if with_stats:
+                grads, aux = consensus_stats(grads, axis)
+                aux["ref_sq"] = _sq_sum(grads)
+                aux["worker_loss"] = gather_worker_scalar(loss, axis)
+            else:
+                grads = jax.lax.pmean(grads, axis)
+                aux = {}
             loss = jax.lax.pmean(loss, axis)
             # BN running stats etc. must stay replicated
             state = jax.lax.pmean(state, axis)
             params, history = updater(params, grads, history, lr_fn(it), it)
-            return params, state, history, loss
+            return params, state, history, loss, aux
 
         bspec = _batch_specs(batch_example, axis,
                              batch_dim=0 if iter_size == 1 else 1)
@@ -246,7 +261,7 @@ class DataParallelSolver(Solver):
             sharded = shard_map(
                 step, mesh=self.mesh,
                 in_specs=(P(), P(), P(), bspec, P(), P()),
-                out_specs=(P(), P(), P(), P()),
+                out_specs=(P(), P(), P(), P(), P()),
                 check_vma=False)
             return jax.jit(sharded, donate_argnums=(0, 1, 2))
 
@@ -283,13 +298,14 @@ class DataParallelSolver(Solver):
         dev_batch = shard_batch(batch, self.mesh, self.axis,
                                 batch_dim=0 if int(self.param.iter_size) == 1
                                 else 1)
-        self.params, self.state, self.history, loss = self._jit_train(
+        self.params, self.state, self.history, loss, aux = self._jit_train(
             self.params, self.state, self.history, dev_batch,
             jnp.asarray(self.iter, jnp.int32), key)
         self.iter += 1
         host_s = _t.perf_counter() - t0
         self._timing["train_step"] += host_s
-        self._obs_step(host_s, loss, batch)
+        self._obs_step(host_s, loss, batch,
+                       aux=dict(aux, kind="grads") if aux else None)
         return self._chaos_loss(loss)
 
     def _build_eval_step(self):
@@ -355,6 +371,7 @@ class LocalSGDSolver(Solver):
         self.average_history = bool(average_history)
         super().__init__(solver_param, **kw)
         self._jit_round = None
+        self._round_idx = 0
 
     def _build_round(self, batch_example):
         net, updater, lr_fn = self.net, self.updater, self.lr_fn
@@ -369,6 +386,12 @@ class LocalSGDSolver(Solver):
             unroll = True if all(d.platform == "cpu"
                                  for d in self.mesh.devices.flat) else 1
         average_history = self.average_history
+        # metrics on -> measure the paper's tau drift where it happens:
+        # each worker's L2 distance from the post-average consensus,
+        # computed on-device BEFORE the averaging pmean (the average
+        # itself comes from consensus_stats, so the extra cost is one
+        # elementwise pass + scalar collectives, never a host gather)
+        with_stats = self.stepstats is not None
         loss_fn = self._wrapped_loss(net)
 
         def one_step(params, state, history, batch, it, rng):
@@ -381,6 +404,7 @@ class LocalSGDSolver(Solver):
             return params, new_state, history, loss
 
         def round_fn(params, state, history, batches, it0, rng):
+            params_in = params          # the round's broadcast weights
             rng = jax.random.fold_in(rng, jax.lax.axis_index(axis))
 
             def body(carry, inp):
@@ -395,8 +419,18 @@ class LocalSGDSolver(Solver):
                 body, (params, state, history),
                 (batches, jnp.arange(tau, dtype=jnp.int32)),
                 unroll=unroll)
-            # collect & average (CifarApp.scala:131-133) == one pmean
-            params = jax.lax.pmean(params, axis)
+            # collect & average (CifarApp.scala:131-133) == one pmean —
+            # with stats on, consensus_stats IS that pmean plus each
+            # worker's drift from the result (the paper's tau drift),
+            # and ref_sq is the consensus round update's squared norm
+            if with_stats:
+                params, aux = consensus_stats(params, axis)
+                aux["ref_sq"] = tree_sq_dist(params, params_in)[1]
+                aux["worker_loss"] = gather_worker_scalar(
+                    jnp.mean(losses), axis)
+            else:
+                params = jax.lax.pmean(params, axis)
+                aux = {}
             state = jax.lax.pmean(state, axis)
             if average_history:
                 history = jax.lax.pmean(history, axis)
@@ -405,14 +439,14 @@ class LocalSGDSolver(Solver):
             # worker's mean sits on the fetching host's first device
             # (observably different across hosts/modes)
             return params, state, history, jax.lax.pmean(jnp.mean(losses),
-                                                         axis)
+                                                         axis), aux
 
         bspec = _batch_specs(batch_example, axis, batch_dim=1)
         with context.axis_context(data=axis):
             sharded = shard_map(
                 round_fn, mesh=self.mesh,
                 in_specs=(P(), P(), P(), bspec, P(), P()),
-                out_specs=(P(), P(), P(), P()),
+                out_specs=(P(), P(), P(), P(), P()),
                 check_vma=False)
             return jax.jit(sharded, donate_argnums=(0, 1, 2))
 
@@ -434,6 +468,26 @@ class LocalSGDSolver(Solver):
                  "(the paper's broadcast+collect)",
             paper_broadcast_collect_bytes=broadcast_collect_bytes(pb, n))
 
+    def _round_latencies(self, round_s):
+        """Per-worker latencies for the finished round. A single fused
+        XLA program has no native per-worker timer, so the base vector is
+        the round wall time for every worker; a chaos-injected stall with
+        a worker attribution (stall_worker=W) lands its seconds on W
+        alone — its peers finished a stall early, exactly the shape a
+        per-host timer would report for a real straggler."""
+        n = self.mesh.shape[self.axis]
+        if n <= 1 or round_s is None:
+            return None
+        lat = [float(round_s)] * n
+        if self.chaos is not None:
+            rep = self.chaos.pop_stall()
+            if rep and rep[0] is not None and 0 <= rep[0] < n:
+                w, sec = rep
+                base = max(0.0, float(round_s) - float(sec))
+                lat = [base] * n
+                lat[w] = float(round_s)
+        return lat
+
     def train_round(self, batches):
         """One outer round. ``batches``: dict of arrays with leading axes
         (tau, global_batch, ...) — tau steps, batch dim sharded across
@@ -445,14 +499,22 @@ class LocalSGDSolver(Solver):
         self.rng, key = jax.random.split(self.rng)
         t0 = _t.perf_counter()
         dev = shard_batch(batches, self.mesh, self.axis, batch_dim=1)
-        self.params, self.state, self.history, loss = self._jit_round(
+        self.params, self.state, self.history, loss, aux = self._jit_round(
             self.params, self.state, self.history, dev,
             jnp.asarray(self.iter, jnp.int32), key)
         self.iter += self.tau
         host_s = _t.perf_counter() - t0
         self._timing["train_round"] += host_s
         self._obs_step(host_s, loss, batches)
-        return self._chaos_loss(loss)
+        loss = self._chaos_loss(loss)   # may stall (the injected straggler)
+        if aux:
+            # once per sync round (rounds are coarse; the fetch is a few
+            # scalars): divergence event + straggler/skew/trend detectors
+            self._observe_sync_round(
+                dict(aux, kind="params"),
+                round_s=_t.perf_counter() - t0, round_idx=self._round_idx)
+        self._round_idx += 1
+        return loss
 
     def run(self, num_rounds, batch_fn, test_data_fn=None, test_every=10,
             snapshot_prefix=None, snapshot_every=0, resume=None,

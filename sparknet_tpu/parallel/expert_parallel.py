@@ -147,6 +147,7 @@ class ExpertParallelSolver(Solver):
         # the 1/ep loss-normalization factor (module docstring)
         other = [da] + ([sa] if sa else [])
         flags = self._expert_flags
+        with_stats = self.stepstats is not None
         loss_fn = self._wrapped_loss(net)
 
         def pmean_over(x, axes):
@@ -174,17 +175,26 @@ class ExpertParallelSolver(Solver):
             (loss, state), grads = jax.value_and_grad(
                 lf, has_aux=True)(params)
             grads = reduce_grads(grads)
+            if with_stats:
+                # per-data-worker loss (averaged over its expert/seq
+                # columns first): the loss-skew detector's input — a
+                # token shard training differently from its peers
+                from ..obs.divergence import gather_worker_scalar
+                aux = {"worker_loss": gather_worker_scalar(
+                    pmean_over(loss, [ea] + ([sa] if sa else [])), da)}
+            else:
+                aux = {}
             loss = pmean_over(loss, [ea] + other)
             state = pmean_over(state, [ea] + other)
             params, history = updater(params, grads, history, lr_fn(it), it)
-            return params, state, history, loss, it + 1
+            return params, state, history, loss, it + 1, aux
 
         bspec = self._batch_spec(batch_example)
         pspec, hspec = self._param_specs, self._history_specs
         sharded = shard_map(
             step, mesh=self.mesh,
             in_specs=(pspec, P(), hspec, bspec, P(), P()),
-            out_specs=(pspec, P(), hspec, P(), P()),
+            out_specs=(pspec, P(), hspec, P(), P(), P()),
             check_vma=False)
         return jax.jit(sharded, donate_argnums=(0, 1, 2))
 
@@ -256,13 +266,13 @@ class ExpertParallelSolver(Solver):
             if self._it_dev is None:
                 self._it_dev = jnp.asarray(self.iter, jnp.int32)
             (self.params, self.state, self.history, loss,
-             self._it_dev) = self._jit_train(
+             self._it_dev, aux) = self._jit_train(
                 self.params, self.state, self.history, dev,
                 self._it_dev, key)
         self.iter += 1
         host_s = _time.perf_counter() - t0
         self._timing["train_step"] += host_s
-        self._obs_step(host_s, loss, batch)
+        self._obs_step(host_s, loss, batch, aux=aux or None)
         return loss
 
     def _build_eval_step(self):

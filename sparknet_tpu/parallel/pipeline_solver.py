@@ -77,12 +77,13 @@ class PipelineLMSolver:
         self.metrics = metrics
         from ..obs import Tracer
         self.tracer = Tracer(self.metrics)
-        self.stepstats = self.comms = None
+        self.stepstats = self.comms = self.memstats = None
         self._comms_registered = False
         if self.metrics is not None:
-            from ..obs import StepAccounting, CommsMeter
+            from ..obs import StepAccounting, CommsMeter, MemoryMonitor
             self.stepstats = StepAccounting(self.metrics)
             self.comms = CommsMeter(self.metrics)
+            self.memstats = MemoryMonitor(self.metrics)
         self.mesh = mesh if mesh is not None else make_mesh({axis: -1})
         self.axis = axis
         S = self.mesh.shape[axis]
@@ -230,12 +231,18 @@ class PipelineLMSolver:
         it = self.iter - 1
         self.comms.add_h2d(tree_bytes(batch))
         self.comms.tick(it)
-        self.stepstats.observe(it, host_s, result=result,
-                               jit_fn=self._jit_train, batch=batch)
+        sampled = self.stepstats.observe(it, host_s, result=result,
+                                         jit_fn=self._jit_train, batch=batch)
+        if sampled and self.memstats is not None:
+            try:
+                self.memstats.sample(it, jit_fns=(self._jit_train,))
+            except Exception as e:
+                self.log(f"memstats sampling failed: {e!r}")
 
     def close(self):
         """Flush observability summaries; close an owned metrics stream.
         Mirrors Solver.close() so drivers stay solver-agnostic."""
+        self.memstats = None
         if self.stepstats is not None:
             try:
                 self.stepstats.flush(self.iter)

@@ -26,6 +26,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from ..solver.solver import Solver
+from ..obs.divergence import consensus_stats, _sq_sum, gather_worker_scalar
 from .data_parallel import _rebatch, _batch_specs, shard_batch, \
     check_global_feed, check_seq_shardable_losses
 from . import context
@@ -79,6 +80,7 @@ class SeqParallelSolver(Solver):
     def _sharded_step(self, batch_example):
         net, updater, lr_fn = self.local_net, self.updater, self.lr_fn
         da, sa = self.data_axis, self.seq_axis
+        with_stats = self.stepstats is not None
         loss_fn = self._wrapped_loss(net)
 
         def step(params, state, history, batch, it, rng):
@@ -92,17 +94,29 @@ class SeqParallelSolver(Solver):
                 return loss, new_state
             (loss, state), grads = jax.value_and_grad(
                 lf, has_aux=True)(params)
-            grads = jax.lax.pmean(jax.lax.pmean(grads, sa), da)
+            # seq shards hold partial grads of the same data-worker's
+            # batch slice: average over seq first, THEN measure the
+            # between-data-worker divergence (the gradient noise) around
+            # the data-axis pmean when stats are on
+            g_seq = jax.lax.pmean(grads, sa)
+            if with_stats:
+                grads, aux = consensus_stats(g_seq, da)
+                aux["ref_sq"] = _sq_sum(grads)
+                aux["worker_loss"] = gather_worker_scalar(
+                    jax.lax.pmean(loss, sa), da)
+            else:
+                grads = jax.lax.pmean(g_seq, da)
+                aux = {}
             loss = jax.lax.pmean(jax.lax.pmean(loss, sa), da)
             state = jax.lax.pmean(jax.lax.pmean(state, sa), da)
             params, history = updater(params, grads, history, lr_fn(it), it)
-            return params, state, history, loss, it + 1
+            return params, state, history, loss, it + 1, aux
 
         bspec = self._batch_spec(batch_example)
         sharded = shard_map(
             step, mesh=self.mesh,
             in_specs=(P(), P(), P(), bspec, P(), P()),
-            out_specs=(P(), P(), P(), P(), P()),
+            out_specs=(P(), P(), P(), P(), P(), P()),
             check_vma=False)
         return jax.jit(sharded, donate_argnums=(0, 1, 2))
 
@@ -160,13 +174,14 @@ class SeqParallelSolver(Solver):
             if self._it_dev is None:     # device-resident counter, like
                 self._it_dev = jnp.asarray(self.iter, jnp.int32)  # Solver
             (self.params, self.state, self.history, loss,
-             self._it_dev) = self._jit_train(
+             self._it_dev, aux) = self._jit_train(
                 self.params, self.state, self.history, dev,
                 self._it_dev, key)
         self.iter += 1
         host_s = _time.perf_counter() - t0
         self._timing["train_step"] += host_s
-        self._obs_step(host_s, loss, batch)
+        self._obs_step(host_s, loss, batch,
+                       aux=dict(aux, kind="grads") if aux else None)
         return loss
 
     def _build_eval_step(self):

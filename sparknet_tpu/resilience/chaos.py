@@ -12,6 +12,11 @@ subsystem claims to survive — on a schedule tests can replay exactly:
                    probability P (seeded rng) — exercises retry backoff
   stall_step=K, stall_s=S   step K blocks the host for S seconds (once) —
                    exercises the watchdog stall path
+  stall_worker=W   attribute the stall to mesh worker W: the injected
+                   seconds land on W's per-round latency while its peers
+                   finish early — a simulated straggler the health
+                   detector (obs/health.py) must name
+  stall_repeat=1   stall at EVERY step >= K (a persistent straggler)
   sigterm_round=R  the process SIGTERMs itself after round/block R (once)
                    — exercises snapshot-then-stop + `--resume auto`
 
@@ -55,13 +60,17 @@ def active_chaos():
 
 class ChaosMonkey:
     def __init__(self, nan_step=None, nan_repeat=False, io_p=0.0,
-                 stall_step=None, stall_s=0.0, sigterm_round=None,
+                 stall_step=None, stall_s=0.0, stall_worker=None,
+                 stall_repeat=False, sigterm_round=None,
                  seed=0, metrics=None, log_fn=print):
         self.nan_step = None if nan_step is None else int(nan_step)
         self.nan_repeat = bool(nan_repeat)
         self.io_p = float(io_p)
         self.stall_step = None if stall_step is None else int(stall_step)
         self.stall_s = float(stall_s)
+        self.stall_worker = None if stall_worker is None else int(stall_worker)
+        self.stall_repeat = bool(stall_repeat)
+        self._last_stall = None
         self.sigterm_round = None if sigterm_round is None \
             else int(sigterm_round)
         self._rng = np.random.RandomState(seed)
@@ -86,9 +95,10 @@ class ChaosMonkey:
             if not eq:
                 raise ValueError(f"chaos spec needs key=value, got {part!r}")
             fields[k.strip()] = v.strip()
-        known = {"nan_step": int, "nan_repeat": lambda v: v not in
-                 ("0", "false", "False", ""), "io_p": float,
+        truthy = lambda v: v not in ("0", "false", "False", "")  # noqa: E731
+        known = {"nan_step": int, "nan_repeat": truthy, "io_p": float,
                  "stall_step": int, "stall_s": float,
+                 "stall_worker": int, "stall_repeat": truthy,
                  "sigterm_round": int, "seed": int}
         unknown = set(fields) - set(known)
         if unknown:
@@ -121,11 +131,29 @@ class ChaosMonkey:
             raise ChaosIOError(f"injected IO error reading {where or '?'}")
 
     def maybe_stall(self, it):
-        if self.stall_step is not None and not self._stall_fired \
-                and it >= self.stall_step and self.stall_s > 0:
-            self._stall_fired = True
-            self._event("stall", iter=it, seconds=self.stall_s)
-            time.sleep(self.stall_s)
+        """Block the host for stall_s at/after stall_step (every step
+        with stall_repeat). Returns the seconds injected (0.0 if none)
+        and records the attribution for pop_stall()."""
+        if self.stall_step is None or it < self.stall_step \
+                or self.stall_s <= 0:
+            return 0.0
+        if self._stall_fired and not self.stall_repeat:
+            return 0.0
+        self._stall_fired = True
+        ev = {"iter": it, "seconds": self.stall_s}
+        if self.stall_worker is not None:
+            ev["worker"] = self.stall_worker
+        self._event("stall", **ev)
+        self._last_stall = (self.stall_worker, self.stall_s)
+        time.sleep(self.stall_s)
+        return self.stall_s
+
+    def pop_stall(self):
+        """(worker, seconds) of the stall injected since the last call,
+        or None — how the sync-round latency probe attributes the
+        injected straggler to a worker."""
+        rep, self._last_stall = self._last_stall, None
+        return rep
 
     def maybe_sigterm(self, round_):
         if self.sigterm_round is not None and not self._term_fired \

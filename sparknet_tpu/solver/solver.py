@@ -86,10 +86,21 @@ class Solver:
         self.tracer = tracer if tracer is not None else Tracer(self.metrics)
         self.stepstats = self.comms = None
         self._comms_registered = False
+        # training-dynamics health layer (obs divergence/health/memstats):
+        # armed by default with metrics; sharded solvers compute the
+        # divergence aux inside their compiled sync round and this base
+        # class fetches/emits it on the step-sample cadence
+        self.divergence = self.health = self.memstats = None
+        self.last_divergence = None
         if self.metrics is not None:
-            from ..obs import StepAccounting, CommsMeter
+            from ..obs import (StepAccounting, CommsMeter, DivergenceMeter,
+                               HealthMonitor, MemoryMonitor)
             self.stepstats = StepAccounting(self.metrics)
             self.comms = CommsMeter(self.metrics)
+            self.divergence = DivergenceMeter(self.metrics)
+            self.health = HealthMonitor(self.metrics, log_fn=self.log,
+                                        solver=self)
+            self.memstats = MemoryMonitor(self.metrics)
         self.watchdog = None
         # resilience hooks (sparknet_tpu.resilience): keep-N snapshot
         # retention (None = keep all), an optional RecoveryPolicy armed via
@@ -393,10 +404,13 @@ class Solver:
                         n_devices=jax.device_count(),
                         param_bytes=tree_bytes(self.params))
 
-    def _obs_step(self, host_s, result, batch):
+    def _obs_step(self, host_s, result, batch, aux=None):
         """Per-step hook called by every train_step/train_round variant:
         h2d byte counting, comms emission, step accounting. No-op (one
-        attribute test) when metrics is off."""
+        attribute test) when metrics is off. ``aux``: the sync round's
+        on-device divergence stats (sharded solvers) — fetched only at
+        step-sample points, where the host already paid the device
+        sync, so the async-dispatch discipline is preserved."""
         if self.stepstats is None:
             return
         if not self._comms_registered:
@@ -411,8 +425,60 @@ class Solver:
         self.comms.tick(it)
         jit_fn = self._jit_train if self._jit_train is not None \
             else getattr(self, "_jit_round", None)   # LocalSGDSolver
-        self.stepstats.observe(it, host_s, result=result,
-                               jit_fn=jit_fn, batch=batch)
+        sampled = self.stepstats.observe(it, host_s, result=result,
+                                         jit_fn=jit_fn, batch=batch)
+        if sampled:
+            if self.memstats is not None:
+                try:
+                    self.memstats.sample(it, jit_fns=(jit_fn,))
+                except Exception as e:
+                    self.log(f"memstats sampling failed: {e!r}")
+            if aux:
+                self._observe_sync_round(aux)
+
+    def _round_latencies(self, round_s):
+        """Per-worker latencies for the just-finished sync round, or None
+        when the solver has no per-worker attribution. Base solvers have
+        one worker; LocalSGDSolver overrides with chaos-stall (and, in
+        real fleets, per-host timer) attribution."""
+        return None
+
+    def _observe_sync_round(self, aux, round_s=None, round_idx=None):
+        """Fetch one sync round's on-device aux stats (a few scalars),
+        emit the ``divergence`` event, and feed the health detectors.
+        Called by _obs_step at sample points (per-step solvers) or once
+        per round (LocalSGDSolver). Never raises into the step loop."""
+        if self.divergence is None or not aux:
+            return None
+        try:
+            aux = jax.device_get(aux)
+            d = self.divergence.observe(
+                self.iter - 1, aux, kind=aux.get("kind", "params"),
+                tau=getattr(self, "tau", None), round_idx=round_idx)
+            self.last_divergence = d
+            if self.health is not None:
+                self.health.observe_round(
+                    self.iter - 1, round_idx=round_idx,
+                    worker_losses=aux.get("worker_loss"),
+                    latencies=self._round_latencies(round_s)
+                    if round_s is not None else None,
+                    divergence=d)
+            return d
+        except Exception as e:          # monitoring must never kill a run
+            self.log(f"divergence observation failed: {e!r}")
+            return None
+
+    def arm_health(self, **kw):
+        """(Re)configure the health detectors (CLI --health-* flags).
+        Replaces the default monitor, preserving the metrics sink; pass
+        enabled=False to disarm."""
+        if not kw.pop("enabled", True):
+            self.health = None
+            return None
+        from ..obs import HealthMonitor
+        kw.setdefault("log_fn", self.log)
+        self.health = HealthMonitor(self.metrics, solver=self, **kw)
+        return self.health
 
     def close(self):
         """Teardown: stop the watchdog thread (a leaked monitor thread
@@ -422,6 +488,14 @@ class Solver:
         if self.watchdog is not None:
             self.watchdog.stop()
             self.watchdog = None
+        if self.health is not None:
+            try:
+                if self.health.alarms and self.metrics is not None:
+                    self.metrics.log("health_summary",
+                                     **self.health.summary())
+            finally:
+                self.health = None
+        self.divergence = self.memstats = None
         if self.stepstats is not None:
             try:
                 self.stepstats.flush(self.iter)
