@@ -10,6 +10,10 @@
 #       --resume auto continues the iter counter and loss curve
 #   (e) a chaos-injected NaN rolls back, the run completes to target, and
 #       the report surfaces the recovery events.
+# Elasticity (ISSUE 4):
+#   (f) a chaos-killed worker is evicted, the run completes on the
+#       survivors, the eviction (and readmission) appear in `sparknet
+#       report`, and dropping below --quorum exits with code 4.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 export JAX_PLATFORMS=cpu
@@ -163,5 +167,48 @@ if python -m sparknet_tpu monitor "$tmp/does-not-exist.jsonl" --once \
     2> /dev/null; then
     echo "monitor on a missing file should exit non-zero"; exit 1
 fi
+
+# ------------------------------------------------- elasticity stage ----
+# Robustness (ISSUE 4): chaos-kill worker 1 at round 2 of a 4-worker
+# local-SGD run armed with --quorum 2: the run must COMPLETE on the
+# survivors with finite losses, the per-worker eviction (and the
+# cooldown readmission) must land in the metrics JSONL and render in
+# `sparknet report`; a kill that breaks the quorum must exit 4.
+XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+python -m sparknet_tpu cifar --workers 4 --tau 2 --rounds 8 \
+    --test-every 100 --metrics "$tmp/elastic.jsonl" \
+    --chaos "kill_worker=1,kill_round=2" \
+    --quorum 2 --evict-after 1 --readmit-after 3 | tee "$tmp/elastic.out"
+grep -q "EVICTED worker 1" "$tmp/elastic.out"
+
+python - "$tmp" <<'EOF'
+import json, math, sys, os
+evs = [json.loads(l) for l in open(os.path.join(sys.argv[1],
+                                                "elastic.jsonl"))]
+ev = [e for e in evs if e["event"] == "eviction"]
+assert ev and ev[0]["worker"] == 1 and ev[0]["reason"] == "chaos_kill", ev
+rd = [e for e in evs if e["event"] == "readmission"]
+assert rd and rd[0]["worker"] == 1, rd
+rounds = [e for e in evs if e["event"] == "round"]
+assert len(rounds) == 8, f"run did not complete: {len(rounds)}/8 rounds"
+assert all(math.isfinite(e["loss"]) for e in rounds), \
+    "a dead worker poisoned a round loss"
+print("elastic OK: eviction + readmission recorded, run completed")
+EOF
+
+python -m sparknet_tpu report "$tmp/elastic.jsonl" | tee "$tmp/elastic.rep"
+grep -q "elastic membership: " "$tmp/elastic.rep"
+grep -q "evicted worker 1" "$tmp/elastic.rep"
+
+# below-quorum must abort with the documented exit code 4 (DEPLOY.md)
+rc=0
+XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+python -m sparknet_tpu cifar --workers 2 --tau 2 --rounds 6 \
+    --test-every 100 --chaos "kill_worker=0,kill_round=1" \
+    --quorum 2 --evict-after 1 > "$tmp/quorum.out" 2>&1 || rc=$?
+test "$rc" -eq 4 || { echo "expected exit 4 on quorum loss, got $rc"
+                      cat "$tmp/quorum.out"; exit 1; }
+grep -q "QUORUM LOST" "$tmp/quorum.out"
+echo "elasticity stage OK: eviction survived, quorum loss exits 4"
 
 echo "SMOKE OK"

@@ -115,16 +115,35 @@ class CifarApp:
 
     def _tau_batches(self, tau):
         """(tau, workers*batch, ...) arrays: each worker's contiguous window
-        of its partition (the MinibatchSampler random-window behavior)."""
-        n = tau * TRAIN_BATCH * self.num_workers
-        imgs, labs = self._train_arrays(n)
+        of its partition (the MinibatchSampler random-window behavior).
+
+        With elastic membership armed and workers evicted, the fresh
+        data is drawn for the LIVE workers only — the re-partitioning of
+        the dead workers' stream across the survivors — and dead mesh
+        slots receive a survivor's copy, which the round's validity mask
+        discards on device (resilience/elastic.py). Membership changes
+        reach here with the prefetch queue's 1-2 round lag, exactly like
+        batches already in flight when a real worker dies."""
+        n_slots = self.solver.mesh.shape["data"]
+        elastic = getattr(self.solver, "elastic", None)
+        if elastic is not None and elastic.live_count() < n_slots:
+            from ..resilience.elastic import expand_to_slots
+            k = elastic.live_count()
+            imgs, labs = self._train_arrays(tau * TRAIN_BATCH * k)
+            si = list(imgs.reshape(k, tau, TRAIN_BATCH, 3, 32, 32))
+            sl = list(labs.reshape(k, tau, TRAIN_BATCH))
+            owners = elastic.shard_owners()
+            imgs = expand_to_slots(si, owners)
+            labs = expand_to_slots(sl, owners)
+        else:
+            imgs, labs = self._train_arrays(tau * TRAIN_BATCH * n_slots)
+            imgs = imgs.reshape(n_slots, tau, TRAIN_BATCH, 3, 32, 32)
+            labs = labs.reshape(n_slots, tau, TRAIN_BATCH)
         # worker w gets a contiguous run of tau batches from its partition;
         # reorder to (tau, workers*batch) so shard_batch slices per worker
-        imgs = imgs.reshape(self.num_workers, tau, TRAIN_BATCH, 3, 32, 32) \
-            .transpose(1, 0, 2, 3, 4, 5) \
-            .reshape(tau, self.num_workers * TRAIN_BATCH, 3, 32, 32)
-        labs = labs.reshape(self.num_workers, tau, TRAIN_BATCH) \
-            .transpose(1, 0, 2).reshape(tau, -1)
+        imgs = imgs.transpose(1, 0, 2, 3, 4, 5) \
+            .reshape(tau, n_slots * TRAIN_BATCH, 3, 32, 32)
+        labs = labs.transpose(1, 0, 2).reshape(tau, -1)
         return {"data": imgs, "label": labs}
 
     def _test_batch_size(self):
@@ -210,6 +229,12 @@ class CifarApp:
                                                        / max(dt, 1e-9), 1))
         finally:
             batches.close()
+            el = getattr(self.solver, "elastic", None)
+            if el is not None and (el.evictions or el.readmissions):
+                s = el.summary()
+                self.log(f"elastic: {len(s['evictions'])} eviction(s), "
+                         f"{len(s['readmissions'])} readmission(s); "
+                         f"{s['live']}/{s['world']} workers live")
             h = getattr(self.solver, "health", None)
             if h is not None and h.alarms:
                 s = h.summary()

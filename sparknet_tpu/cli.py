@@ -198,6 +198,7 @@ def cmd_train(args):
                             lr_decay=args.recover_lr_decay,
                             explode_factor=args.recover_explode_factor)
     _apply_health_flags(solver, args)
+    _apply_elastic_flags(solver, args)
     if args.weights:
         solver.load_weights(args.weights)
     if args.snapshot:
@@ -250,6 +251,7 @@ def cmd_train(args):
     prof = JaxProfiler(args.profile)
     from .resilience.chaos import active_chaos
     from .resilience.recovery import RecoveryAbort
+    from .resilience.elastic import QuorumLost, EXIT_QUORUM_LOST
     blocks_done = 0
     rc = 0
     try:
@@ -265,6 +267,16 @@ def cmd_train(args):
                         # known-good snapshot (if any) is intact on disk
                         print(f"ABORT: {e}")
                         rc = 3
+                        break
+                    except QuorumLost as e:
+                        # too few live workers for a trustworthy
+                        # consensus — distinct exit for the supervisor
+                        # (DEPLOY.md runbook). The masked consensus up
+                        # to here is healthy: keep it for the relaunch.
+                        print(f"QUORUM LOST: {e}")
+                        if prefix:
+                            solver.snapshot(prefix=prefix)
+                        rc = EXIT_QUORUM_LOST
                         break
                 blocks_done += 1
                 prof.maybe_stop()
@@ -480,7 +492,13 @@ def cmd_cifar(args):
     if ch is not None and ch.metrics is None and app.metrics is not None:
         ch.metrics = app.metrics     # chaos events land in the run's JSONL
     _apply_health_flags(app.solver, args)
-    app.run(num_rounds=args.rounds, test_every=args.test_every)
+    _apply_elastic_flags(app.solver, args)
+    from .resilience.elastic import QuorumLost, EXIT_QUORUM_LOST
+    try:
+        app.run(num_rounds=args.rounds, test_every=args.test_every)
+    except QuorumLost as e:
+        print(f"QUORUM LOST: {e}")
+        return EXIT_QUORUM_LOST
     return 0
 
 
@@ -707,6 +725,42 @@ def cmd_monitor(args):
     return 0 if state.events else 2
 
 
+def _add_elastic_flags(p):
+    """--quorum / --evict-after / --readmit-after: the elastic
+    membership layer (resilience/elastic.py). Passing any of them arms
+    an ElasticPolicy on the sharded solver."""
+    p.add_argument("--quorum", type=int, default=0, metavar="N",
+                   help="arm elastic membership: sync rounds become "
+                        "validity-masked quorum averages that survive "
+                        "worker loss; abort with exit 4 when fewer than "
+                        "N workers are live (0 = elasticity off unless "
+                        "--evict-after/--readmit-after is given, then "
+                        "quorum defaults to 1)")
+    p.add_argument("--evict-after", type=int, default=None, metavar="R",
+                   help="evict a worker after R consecutive rounds with "
+                        "an invalid (non-finite) contribution "
+                        "(default 2); its data shard re-spreads over "
+                        "the survivors")
+    p.add_argument("--readmit-after", type=int, default=None, metavar="R",
+                   help="readmit an evicted worker after an R-round "
+                        "cooldown, restarting it from the consensus "
+                        "weights (default 5; 0 = never readmit)")
+
+
+def _apply_elastic_flags(solver, args):
+    if not hasattr(solver, "arm_elastic"):
+        return
+    on = args.quorum > 0 or args.evict_after is not None \
+        or args.readmit_after is not None
+    if not on:
+        return
+    solver.arm_elastic(
+        quorum=max(1, args.quorum),
+        evict_after=args.evict_after if args.evict_after is not None else 2,
+        readmit_after=args.readmit_after
+        if args.readmit_after is not None else 5)
+
+
 def _add_health_flags(p):
     """--health-* threshold flags shared by the training verbs; applied
     via _apply_health_flags after the solver is built."""
@@ -837,6 +891,7 @@ def main(argv=None):
                         "(also via SPARKNET_CHAOS; see "
                         "sparknet_tpu/resilience/chaos.py)")
     _add_health_flags(t)
+    _add_elastic_flags(t)
     t.set_defaults(fn=cmd_train)
 
     te = sub.add_parser("test", help="score a model")
@@ -942,8 +997,11 @@ def main(argv=None):
     c.add_argument("--chaos", metavar="SPEC",
                    help="deterministic fault injection (e.g. "
                         "'stall_step=10,stall_s=2,stall_worker=1' to "
-                        "simulate a straggler; also via SPARKNET_CHAOS)")
+                        "simulate a straggler, or "
+                        "'kill_worker=1,kill_round=3' to crash a worker "
+                        "mid-run; also via SPARKNET_CHAOS)")
     _add_health_flags(c)
+    _add_elastic_flags(c)
     c.set_defaults(fn=cmd_cifar)
 
     lm = sub.add_parser("lm", help="transformer-LM driver (synthetic "

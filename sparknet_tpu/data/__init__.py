@@ -2,7 +2,7 @@
 path with loader-push into device memory)."""
 
 from .cifar import CifarDataset, read_batch_file, write_batch_file
-from .sampler import MinibatchSampler
+from .sampler import MinibatchSampler, partition_owners
 from .synthetic import class_gaussian_images, batch_stream
 from .lmdb import LMDBReader, LMDBWriter
 from .datum import array_to_datum, datum_to_array, encoded_datum
@@ -11,7 +11,8 @@ from .transforms import (DataTransformer, load_mean_binaryproto,
                          save_mean_binaryproto)
 
 __all__ = ["CifarDataset", "read_batch_file", "write_batch_file",
-           "MinibatchSampler", "class_gaussian_images", "batch_stream",
+           "MinibatchSampler", "partition_owners",
+           "class_gaussian_images", "batch_stream",
            "LMDBReader", "LMDBWriter", "array_to_datum", "datum_to_array",
            "encoded_datum", "DatumBatchSource", "build_db_feed", "open_db",
            "DataTransformer", "load_mean_binaryproto",

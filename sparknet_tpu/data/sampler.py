@@ -7,6 +7,11 @@ MinibatchSampler.scala:20-21) and iterate it. The reference's dual
 image/label callback trick (:28-60) existed only because Caffe pulled
 images and labels through two separate C callbacks against one iterator;
 with dict batches there is nothing to keep in lock-step.
+
+partition_owners() is the elastic re-sharding rule (resilience/
+elastic.py): when workers are evicted from the mesh, each dead slot's
+data partition is re-assigned to a survivor round-robin, so the stream
+keeps being consumed by the workers that can still train on it.
 """
 
 import numpy as np
@@ -17,6 +22,7 @@ class MinibatchSampler:
                  rng=None):
         """batches: iterable of batch dicts (or (images, labels) tuples)."""
         rng = rng or np.random
+        self.total = int(total_num_batches)
         self.start = int(rng.randint(0, total_num_batches
                                      - num_sampled_batches + 1))
         self.num_sampled = num_sampled_batches
@@ -32,7 +38,45 @@ class MinibatchSampler:
             raise StopIteration
         target = self.start + self._emitted
         while self._pos < target:
-            batch = next(self._it)
+            try:
+                batch = next(self._it)
+            except StopIteration:
+                # a bare StopIteration here would read as "window done"
+                # (and, inside a generator, PEP 479's opaque
+                # RuntimeError) — the stream lied about its length, say
+                # so with the numbers
+                raise ValueError(
+                    f"batch stream exhausted after {self._pos + 1} "
+                    f"batches; the sampled window [{self.start}, "
+                    f"{self.start + self.num_sampled}) needs "
+                    f"{target + 1} (total_num_batches={self.total})"
+                ) from None
             self._pos += 1
         self._emitted += 1
         return batch
+
+
+def partition_owners(num_partitions, alive):
+    """Map every data partition (one per mesh slot) to the live worker
+    that consumes it: live slots own their partition; dead slots'
+    partitions are re-assigned round-robin across the survivors.
+
+    >>> partition_owners(4, [True, False, True, False])
+    array([0, 0, 2, 2])
+    """
+    alive = np.asarray(alive, bool).ravel()
+    if len(alive) != int(num_partitions):
+        raise ValueError(f"alive mask has {len(alive)} entries for "
+                         f"{num_partitions} partitions")
+    live = np.nonzero(alive)[0]
+    if len(live) == 0:
+        raise ValueError("no live workers to own the partitions")
+    owners = np.empty(int(num_partitions), np.int64)
+    j = 0
+    for p in range(int(num_partitions)):
+        if alive[p]:
+            owners[p] = p
+        else:
+            owners[p] = live[j % len(live)]
+            j += 1
+    return owners

@@ -175,6 +175,17 @@ class DivergenceMeter:
         if wl is not None:
             wl = np.asarray(wl, np.float64).ravel()
             ev["worker_loss"] = [round(float(x), 6) for x in wl]
+        # elastic membership (resilience/elastic.py): how many workers
+        # the masked consensus actually averaged over this round
+        nl = self._f(aux.get("n_live"))
+        if nl is not None:
+            ev["live"] = int(nl)
+            n = len(ev.get("per_worker", ()))
+            if n and nl < n:
+                v = aux.get("valid")
+                if v is not None:
+                    ev["valid"] = [int(x > 0) for x in
+                                   np.asarray(v, np.float64).ravel()]
         self.samples += 1
         self.last = ev
         if emit and self.sink is not None:

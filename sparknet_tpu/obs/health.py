@@ -24,6 +24,16 @@ can alert on:
                     observations in a row by ``trend_factor`` total —
                     tau is outrunning the averaging
   divergence_high   divergence crossed the absolute ``div_abs`` ceiling
+  worker_masked     the compiled round's validity mask zeroed out a
+                    worker the host still considers alive (its replica
+                    went non-finite mid-round; the masked consensus of
+                    resilience/elastic.py already excluded it — this
+                    alarm is the paper trail, and the eviction streak
+                    the ElasticPolicy acts on)
+
+With an ElasticPolicy armed, the detectors receive the alive mask and
+skip evicted workers — a dead slot's (masked, meaningless) latency or
+NaN loss must not keep the straggler/skew alarms firing.
 
 Alarms can *arm* the existing resilience RecoveryPolicy (the solver
 rolls back instead of averaging poison) and carry a tau suggestion —
@@ -125,8 +135,18 @@ class HealthMonitor:
         return getattr(self.solver, "tau", None) if self.solver else None
 
     # -- detectors ---------------------------------------------------------
-    def _check_stragglers(self, it, round_idx, latencies):
-        lat = np.asarray(latencies, np.float64).ravel()
+    @staticmethod
+    def _live_subset(vec, live):
+        """(values, global_worker_ids) restricted to live workers —
+        evicted workers' signals are masked garbage, not anomalies."""
+        vec = np.asarray(vec, np.float64).ravel()
+        if live is None:
+            return vec, np.arange(vec.size)
+        idx = np.asarray([w for w in live if w < vec.size], np.int64)
+        return vec[idx], idx
+
+    def _check_stragglers(self, it, round_idx, latencies, live=None):
+        lat, ids = self._live_subset(latencies, live)
         if lat.size < 2:
             return
         w = int(np.argmax(lat))
@@ -137,21 +157,22 @@ class HealthMonitor:
         ratio = float(lat[w] / max(med, 1e-9))
         if ratio < self.straggler_factor:
             return
-        self.straggler_counts[w] += 1
-        self._alarm("straggler", iter=it, round=round_idx, worker=w,
+        worker = int(ids[w])
+        self.straggler_counts[worker] += 1
+        self._alarm("straggler", iter=it, round=round_idx, worker=worker,
                     latency_s=round(float(lat[w]), 4),
                     median_s=round(med, 4), ratio=round(ratio, 3),
-                    times_flagged=self.straggler_counts[w])
+                    times_flagged=self.straggler_counts[worker])
 
-    def _check_loss_skew(self, it, round_idx, worker_losses):
-        wl = np.asarray(worker_losses, np.float64).ravel()
+    def _check_loss_skew(self, it, round_idx, worker_losses, live=None):
+        wl, ids = self._live_subset(worker_losses, live)
         if wl.size < 2:
             return
         finite = np.isfinite(wl)
         if not finite.all():
             for w in np.nonzero(~finite)[0]:
                 self._alarm("worker_nonfinite", severity="critical",
-                            iter=it, round=round_idx, worker=int(w),
+                            iter=it, round=round_idx, worker=int(ids[w]),
                             loss=str(wl[w]))
             return
         skew = float(wl.max() - wl.min())
@@ -164,8 +185,19 @@ class HealthMonitor:
                 skew > self.loss_skew_min:
             self._alarm("loss_skew", iter=it, round=round_idx,
                         skew=round(skew, 6), ema=round(prior, 6),
-                        worker=int(np.argmax(wl)),
+                        worker=int(ids[int(np.argmax(wl))]),
                         worker_losses=[round(float(x), 6) for x in wl])
+
+    def _check_validity(self, it, round_idx, valid, live=None):
+        """A live worker the device mask zeroed out: its replica went
+        non-finite inside the round. The masked consensus already kept
+        it out of the average; this records WHO, per round, so the
+        membership policy's eviction streaks have a paper trail."""
+        v, ids = self._live_subset(valid, live)
+        for i in range(v.size):
+            if not v[i] > 0:
+                self._alarm("worker_masked", severity="critical",
+                            iter=it, round=round_idx, worker=int(ids[i]))
 
     def _check_divergence(self, it, round_idx, div):
         mean = div.get("mean")
@@ -191,14 +223,24 @@ class HealthMonitor:
 
     # -- public API --------------------------------------------------------
     def observe_round(self, it, round_idx=None, worker_losses=None,
-                      latencies=None, divergence=None):
-        """Feed one sync round's signals. Any subset may be None."""
+                      latencies=None, divergence=None, valid=None,
+                      alive=None):
+        """Feed one sync round's signals. Any subset may be None.
+        ``alive``: the elastic membership mask — evicted workers are
+        excluded from every detector. ``valid``: the round's effective
+        per-worker validity vector (alive AND device-finite)."""
         self._obs += 1
         try:
+            live = None
+            if alive is not None:
+                a = np.asarray(alive).ravel()
+                live = [int(w) for w in range(a.size) if a[w]]
             if latencies is not None:
-                self._check_stragglers(it, round_idx, latencies)
+                self._check_stragglers(it, round_idx, latencies, live)
             if worker_losses is not None:
-                self._check_loss_skew(it, round_idx, worker_losses)
+                self._check_loss_skew(it, round_idx, worker_losses, live)
+            if valid is not None:
+                self._check_validity(it, round_idx, valid, live)
             if divergence:
                 self._check_divergence(it, round_idx, divergence)
         except Exception as e:          # detectors must never kill a run

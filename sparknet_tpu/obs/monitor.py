@@ -47,6 +47,12 @@ class MonitorState:
         self.recoveries = 0
         self.chaos = 0
         self.checkpoint_iter = None
+        # elastic membership (resilience/elastic.py)
+        self.live = None            # last reported live worker count
+        self.evictions = collections.Counter()   # worker -> count
+        self.last_eviction = None
+        self.readmissions = 0
+        self.quorum_lost = None
         self.done = None            # summary event, if the run finished
 
     def update(self, ev):
@@ -97,6 +103,21 @@ class MonitorState:
         elif kind == "checkpoint":
             if _num(ev.get("iter")):
                 self.checkpoint_iter = ev["iter"]
+        elif kind == "eviction":
+            if ev.get("worker") is not None:
+                self.evictions[ev["worker"]] += 1
+            self.last_eviction = ev
+            if _num(ev.get("live")):
+                self.live = ev["live"]
+        elif kind == "readmission":
+            self.readmissions += 1
+            if _num(ev.get("live")):
+                self.live = ev["live"]
+        elif kind == "membership":
+            if ev.get("kind") == "quorum_lost":
+                self.quorum_lost = ev
+            if _num(ev.get("live")):
+                self.live = ev["live"]
         elif kind == "summary":
             self.done = ev
 
@@ -148,6 +169,26 @@ class MonitorState:
             if d.get("top_layers"):
                 L.append("    top layers: " + ", ".join(
                     f"{k}={v:.3g}" for k, v in d["top_layers"]))
+        if self.evictions or self.quorum_lost or self.readmissions:
+            bits = []
+            if self.live is not None:
+                bits.append(f"{self.live} live")
+            bits.append(f"evictions {sum(self.evictions.values())}"
+                        + (" (" + ", ".join(
+                            f"w{w}:{c}" for w, c in
+                            self.evictions.most_common()) + ")"
+                           if self.evictions else ""))
+            if self.readmissions:
+                bits.append(f"readmissions {self.readmissions}")
+            L.append("  membership: " + "  ".join(bits))
+            if self.last_eviction is not None:
+                e = self.last_eviction
+                L.append(f"    last eviction: worker {e.get('worker')} "
+                         f"round {e.get('round')} ({e.get('reason')})")
+            if self.quorum_lost is not None:
+                q = self.quorum_lost
+                L.append(f"    QUORUM LOST: {q.get('live')} live < "
+                         f"quorum {q.get('quorum')}")
         if self.straggler_counts:
             worst = self.straggler_counts.most_common(1)[0]
             L.append(f"  stragglers: worker {worst[0]} flagged "

@@ -173,6 +173,33 @@ def aggregate(events):
             "exhausted": sum(1 for e in rt if e.get("exhausted")),
             "by_where": dict(collections.Counter(
                 str(e.get("where", "?")) for e in rt))}
+    # elastic membership (resilience/elastic.py)
+    ev = [e for e in events if e.get("event") == "eviction"]
+    rd = [e for e in events if e.get("event") == "readmission"]
+    mem = [e for e in events if e.get("event") == "membership"]
+    if ev or rd or mem:
+        el = {"evictions": len(ev), "readmissions": len(rd)}
+        if ev:
+            el["evictions_by_worker"] = {
+                str(k): v for k, v in collections.Counter(
+                    e.get("worker") for e in ev).items()}
+            el["eviction_records"] = [
+                {"worker": e.get("worker"), "round": e.get("round"),
+                 "reason": e.get("reason")} for e in ev][:20]
+        lives = [e["live"] for e in (ev + rd + mem)
+                 if _num(e.get("live"))]
+        if lives:
+            el["last_live"] = lives[-1]
+            el["min_live"] = min(lives)
+        if any(e.get("kind") == "quorum_lost" for e in mem):
+            ql = next(e for e in mem if e.get("kind") == "quorum_lost")
+            el["quorum_lost"] = {k: ql.get(k) for k in
+                                 ("round", "live", "quorum")}
+        if any(e.get("kind") == "mesh_shrunk" for e in mem):
+            ms = [e for e in mem if e.get("kind") == "mesh_shrunk"][-1]
+            el["mesh_shrunk"] = {"from": ms.get("from_world"),
+                                 "to": ms.get("to_world")}
+        rep["elasticity"] = el
     cp = [e for e in events if e.get("event") == "checkpoint"]
     if cp:
         writes = [e for e in cp if e.get("kind") != "resume"]
@@ -372,7 +399,7 @@ def render(rep):
             L.append(f"  {k} = {v}")
 
     if any(rep.get(k) for k in ("recovery", "chaos", "retries",
-                                "checkpoints")):
+                                "checkpoints", "elasticity")):
         hdr("resilience")
         cp = rep.get("checkpoints")
         if cp:
@@ -401,6 +428,25 @@ def render(rep):
         if rt:
             L.append(f"  io retries: {rt['count']} "
                      f"({rt['exhausted']} exhausted)")
+        el = rep.get("elasticity")
+        if el:
+            line = f"  elastic membership: {el.get('evictions', 0)} " \
+                   f"eviction(s), {el.get('readmissions', 0)} " \
+                   "readmission(s)"
+            if _num(el.get("min_live")):
+                line += f", live dipped to {el['min_live']}"
+            L.append(line)
+            for r in el.get("eviction_records", [])[:10]:
+                L.append(f"    evicted worker {r.get('worker')} at round "
+                         f"{r.get('round')}: {r.get('reason')}")
+            if el.get("mesh_shrunk"):
+                L.append(f"    mesh shrunk {el['mesh_shrunk'].get('from')}"
+                         f" -> {el['mesh_shrunk'].get('to')} workers")
+            if el.get("quorum_lost"):
+                q = el["quorum_lost"]
+                L.append(f"    QUORUM LOST at round {q.get('round')}: "
+                         f"{q.get('live')} live < quorum "
+                         f"{q.get('quorum')} (exit 4)")
     if any(rep.get(k) for k in ("divergence", "health", "memstats")):
         hdr("training health")
         d = rep.get("divergence")

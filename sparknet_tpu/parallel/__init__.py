@@ -24,7 +24,8 @@ import importlib
 
 __all__ = [
     "make_mesh", "mesh_axis_size", "distributed_init", "local_batch_slice",
-    "axis_context", "current_axes", "context",
+    "axis_context", "current_axes", "world_context", "current_world",
+    "context",
     "DataParallelSolver", "LocalSGDSolver", "shard_batch",
     "GSPMDSolver", "default_param_rule", "SeqParallelSolver",
     "ExpertParallelSolver",
@@ -39,6 +40,7 @@ _EXPORTS = {
     "make_mesh": "mesh", "mesh_axis_size": "mesh",
     "distributed_init": "mesh", "local_batch_slice": "mesh",
     "axis_context": "context", "current_axes": "context",
+    "world_context": "context", "current_world": "context",
     "DataParallelSolver": "data_parallel", "LocalSGDSolver": "data_parallel",
     "shard_batch": "data_parallel",
     "GSPMDSolver": "gspmd", "default_param_rule": "gspmd",

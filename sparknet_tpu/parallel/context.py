@@ -33,3 +33,25 @@ def axis_context(**axes):
 
 def axis(name):
     return current_axes().get(name)
+
+
+def current_world():
+    """Trace-time world info published by the distributed solvers:
+    {"axis": mesh axis name, "size": N workers, "elastic": bool}.
+    Layers that fold per-worker statistics across the data axis (e.g. a
+    cross-replica batch norm) consult ``elastic`` to know that the
+    surrounding round masks invalid workers out of its collectives —
+    and that they should do the same rather than a plain pmean."""
+    return getattr(_state, "world", {})
+
+
+@contextlib.contextmanager
+def world_context(**info):
+    """e.g. with world_context(axis="data", size=8, elastic=True): trace
+    the round body."""
+    prev = current_world()
+    _state.world = dict(prev, **info)
+    try:
+        yield _state.world
+    finally:
+        _state.world = prev

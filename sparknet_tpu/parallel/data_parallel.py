@@ -23,8 +23,10 @@ import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..solver.solver import Solver
-from ..obs.divergence import (consensus_stats, tree_sq_dist, _sq_sum,
+from ..obs.divergence import (tree_sq_dist, _sq_sum,
                               gather_worker_scalar)
+from ..resilience.elastic import (masked_consensus, masked_consensus_stats,
+                                  masked_scalar_mean, tree_finite)
 from .mesh import DATA_AXIS
 from . import context
 from .compat import shard_map
@@ -206,11 +208,18 @@ class DataParallelSolver(Solver):
         iter_size = int(self.param.iter_size)
         net, updater, lr_fn = self.local_net, self.updater, self.lr_fn
         axis = self.axis
+        n_workers = self.mesh.shape[axis]
         # metrics on -> also measure per-worker gradient divergence around
-        # the averaging pmean (obs/divergence.py): the between-shard
+        # the averaging consensus (obs/divergence.py): the between-shard
         # gradient noise, per layer, plus the per-worker loss vector —
         # all replicated scalars, fetched only at step-sample points
         with_stats = self.stepstats is not None
+        # elastic membership armed -> every collective is validity-masked
+        # (resilience/elastic.py): a worker the host evicted, or whose
+        # grads/loss went non-finite this step, is excluded from the
+        # consensus with its weight renormalized over the live count —
+        # bit-for-bit the old pmean when every worker is valid
+        elastic_on = self.elastic is not None
         loss_fn = self._wrapped_loss(net)   # device-side input transform
         # (shape-polymorphic vmap, so the global-net transform applies
         # unchanged to each shard's slice)
@@ -223,9 +232,11 @@ class DataParallelSolver(Solver):
                 lf, has_aux=True)(params)
             return loss, grads, new_state
 
-        def step(params, state, history, batch, it, rng):
+        def step(params, state, history, batch, it, rng, alive):
             # per-device rng stream (dropout must differ across shards)
-            rng = jax.random.fold_in(rng, jax.lax.axis_index(axis))
+            w = jax.lax.axis_index(axis)
+            my_alive = alive[w]
+            rng = jax.random.fold_in(rng, w)
             if iter_size == 1:
                 loss, grads, state = one_grad(params, state, batch, rng)
             else:
@@ -239,28 +250,45 @@ class DataParallelSolver(Solver):
                 (grads, state, _), losses = jax.lax.scan(
                     body, (zero, state, 0), batch)
                 loss = jnp.mean(losses)
+            # validity: the host-declared alive bit AND (with elasticity
+            # armed) the on-device finite check — a NaN'd shard can't
+            # poison the consensus even before the host evicts it
+            if elastic_on:
+                finite = jnp.logical_and(tree_finite(grads),
+                                         jnp.isfinite(loss))
+                valid = my_alive * finite.astype(jnp.float32)
+            else:
+                valid = my_alive
             # THE collective: replaces P2PSync's up-tree gradient sum —
-            # with stats on, consensus_stats does the same pmean and also
-            # measures each shard's drift from it (the gradient noise)
+            # with stats on, masked_consensus_stats is the same masked
+            # average plus each live shard's drift from it (the
+            # gradient noise)
             if with_stats:
-                grads, aux = consensus_stats(grads, axis)
+                grads, aux = masked_consensus_stats(grads, valid, axis)
                 aux["ref_sq"] = _sq_sum(grads)
                 aux["worker_loss"] = gather_worker_scalar(loss, axis)
+            elif elastic_on:
+                grads, n_live = masked_consensus(grads, valid, axis)
+                aux = {"valid": jax.lax.all_gather(valid, axis),
+                       "n_live": n_live,
+                       "worker_loss": gather_worker_scalar(loss, axis)}
             else:
-                grads = jax.lax.pmean(grads, axis)
+                grads, _ = masked_consensus(grads, valid, axis)
                 aux = {}
-            loss = jax.lax.pmean(loss, axis)
+            loss = masked_scalar_mean(loss, valid, axis)
             # BN running stats etc. must stay replicated
-            state = jax.lax.pmean(state, axis)
+            state, _ = masked_consensus(state, valid, axis)
             params, history = updater(params, grads, history, lr_fn(it), it)
             return params, state, history, loss, aux
 
         bspec = _batch_specs(batch_example, axis,
                              batch_dim=0 if iter_size == 1 else 1)
-        with context.axis_context(data=axis):
+        with context.axis_context(data=axis), \
+                context.world_context(axis=axis, size=n_workers,
+                                      elastic=elastic_on):
             sharded = shard_map(
                 step, mesh=self.mesh,
-                in_specs=(P(), P(), P(), bspec, P(), P()),
+                in_specs=(P(), P(), P(), bspec, P(), P(), P()),
                 out_specs=(P(), P(), P(), P(), P()),
                 check_vma=False)
             return jax.jit(sharded, donate_argnums=(0, 1, 2))
@@ -300,12 +328,16 @@ class DataParallelSolver(Solver):
                                 else 1)
         self.params, self.state, self.history, loss, aux = self._jit_train(
             self.params, self.state, self.history, dev_batch,
-            jnp.asarray(self.iter, jnp.int32), key)
+            jnp.asarray(self.iter, jnp.int32), key, self._alive_mask())
         self.iter += 1
         host_s = _t.perf_counter() - t0
         self._timing["train_step"] += host_s
         self._obs_step(host_s, loss, batch,
                        aux=dict(aux, kind="grads") if aux else None)
+        if aux and self.elastic is not None and self.stepstats is None:
+            # metrics off: _obs_step never fetches the aux, but the
+            # membership controller still needs the validity vector
+            self._observe_sync_round(dict(aux, kind="grads"))
         return self._chaos_loss(loss)
 
     def _build_eval_step(self):
@@ -376,6 +408,7 @@ class LocalSGDSolver(Solver):
     def _build_round(self, batch_example):
         net, updater, lr_fn = self.net, self.updater, self.lr_fn
         axis, tau = self.axis, self.tau
+        n_workers = self.mesh.shape[axis]
         unroll = self.unroll
         if unroll is None:
             # True = fully unroll regardless of tau (works on every jax
@@ -388,10 +421,15 @@ class LocalSGDSolver(Solver):
         average_history = self.average_history
         # metrics on -> measure the paper's tau drift where it happens:
         # each worker's L2 distance from the post-average consensus,
-        # computed on-device BEFORE the averaging pmean (the average
-        # itself comes from consensus_stats, so the extra cost is one
-        # elementwise pass + scalar collectives, never a host gather)
+        # computed on-device BEFORE the averaging collective (the average
+        # itself comes from masked_consensus_stats, so the extra cost is
+        # one elementwise pass + scalar collectives, never a host gather)
         with_stats = self.stepstats is not None
+        # elastic membership armed -> the collect & average is quorum-
+        # based (resilience/elastic.py): host-evicted or non-finite
+        # workers are excluded and the weights renormalize over the live
+        # count — bit-for-bit the old pmean when every worker is valid
+        elastic_on = self.elastic is not None
         loss_fn = self._wrapped_loss(net)
 
         def one_step(params, state, history, batch, it, rng):
@@ -403,9 +441,11 @@ class LocalSGDSolver(Solver):
             params, history = updater(params, grads, history, lr_fn(it), it)
             return params, new_state, history, loss
 
-        def round_fn(params, state, history, batches, it0, rng):
+        def round_fn(params, state, history, batches, it0, rng, alive):
             params_in = params          # the round's broadcast weights
-            rng = jax.random.fold_in(rng, jax.lax.axis_index(axis))
+            w = jax.lax.axis_index(axis)
+            my_alive = alive[w]
+            rng = jax.random.fold_in(rng, w)
 
             def body(carry, inp):
                 params, state, history = carry
@@ -419,33 +459,52 @@ class LocalSGDSolver(Solver):
                 body, (params, state, history),
                 (batches, jnp.arange(tau, dtype=jnp.int32)),
                 unroll=unroll)
-            # collect & average (CifarApp.scala:131-133) == one pmean —
-            # with stats on, consensus_stats IS that pmean plus each
-            # worker's drift from the result (the paper's tau drift),
-            # and ref_sq is the consensus round update's squared norm
+            # validity: the host-declared alive bit AND (with elasticity
+            # armed) the on-device finite check over this worker's
+            # replica — a replica that went NaN mid-round can never
+            # poison the consensus, even before the host evicts it
+            if elastic_on:
+                finite = jnp.logical_and(tree_finite(params),
+                                         jnp.all(jnp.isfinite(losses)))
+                valid = my_alive * finite.astype(jnp.float32)
+            else:
+                valid = my_alive
+            # collect & average (CifarApp.scala:131-133) == one masked
+            # weighted average (== pmean when all workers are valid) —
+            # with stats on, masked_consensus_stats IS that average plus
+            # each live worker's drift from the result (the paper's tau
+            # drift), and ref_sq is the consensus round update's sq norm
             if with_stats:
-                params, aux = consensus_stats(params, axis)
+                params, aux = masked_consensus_stats(params, valid, axis)
                 aux["ref_sq"] = tree_sq_dist(params, params_in)[1]
                 aux["worker_loss"] = gather_worker_scalar(
                     jnp.mean(losses), axis)
+            elif elastic_on:
+                params, n_live = masked_consensus(params, valid, axis)
+                aux = {"valid": jax.lax.all_gather(valid, axis),
+                       "n_live": n_live,
+                       "worker_loss": gather_worker_scalar(
+                           jnp.mean(losses), axis)}
             else:
-                params = jax.lax.pmean(params, axis)
+                params, _ = masked_consensus(params, valid, axis)
                 aux = {}
-            state = jax.lax.pmean(state, axis)
+            state, _ = masked_consensus(state, valid, axis)
             if average_history:
-                history = jax.lax.pmean(history, axis)
-            # the round loss is the mean over ALL workers' tau steps —
-            # without the pmean the P() out_spec would hand back whichever
-            # worker's mean sits on the fetching host's first device
-            # (observably different across hosts/modes)
-            return params, state, history, jax.lax.pmean(jnp.mean(losses),
-                                                         axis), aux
+                history, _ = masked_consensus(history, valid, axis)
+            # the round loss is the mean over the LIVE workers' tau
+            # steps — without the collective the P() out_spec would hand
+            # back whichever worker's mean sits on the fetching host's
+            # first device (observably different across hosts/modes)
+            return params, state, history, \
+                masked_scalar_mean(jnp.mean(losses), valid, axis), aux
 
         bspec = _batch_specs(batch_example, axis, batch_dim=1)
-        with context.axis_context(data=axis):
+        with context.axis_context(data=axis), \
+                context.world_context(axis=axis, size=n_workers,
+                                      elastic=elastic_on):
             sharded = shard_map(
                 round_fn, mesh=self.mesh,
-                in_specs=(P(), P(), P(), bspec, P(), P()),
+                in_specs=(P(), P(), P(), bspec, P(), P(), P()),
                 out_specs=(P(), P(), P(), P(), P()),
                 check_vma=False)
             return jax.jit(sharded, donate_argnums=(0, 1, 2))
@@ -488,6 +547,44 @@ class LocalSGDSolver(Solver):
                 lat[w] = float(round_s)
         return lat
 
+    def shrink_to_survivors(self):
+        """Rebuild the mesh over the live workers' devices — the
+        recompile path for a PERSISTENT eviction (ElasticPolicy
+        shrink_after), so dead slots stop burning compute. Params/state/
+        history are pulled to host and re-placed on the shrunk mesh by
+        the next round's jit; membership resets to the new world (the
+        evicted device left the mesh, so readmission is over). Callers
+        must size subsequent round batches off the NEW world:
+        (tau, live*per_worker_batch). Returns True when the mesh
+        changed."""
+        if self.elastic is None:
+            raise ValueError("shrink_to_survivors needs arm_elastic()")
+        if len(self.mesh.shape) != 1:
+            raise ValueError("mesh shrink supports pure data-axis meshes")
+        live = self.elastic.live()
+        old = self.mesh.shape[self.axis]
+        if len(live) == old:
+            return False
+        from .mesh import make_mesh
+        devices = list(self.mesh.devices.reshape(-1)[live])
+        # host round trip: donated buffers live on the OLD mesh; numpy
+        # copies re-place cleanly when the shrunk round first runs
+        self.params = jax.device_get(self.params)
+        self.state = jax.device_get(self.state)
+        self.history = jax.device_get(self.history)
+        self.mesh = make_mesh({self.axis: len(live)}, devices=devices)
+        self._jit_round = None
+        self._jit_train = None
+        self._jit_eval = None
+        self._comms_registered = False      # re-register with the new n
+        self.elastic.reset_world(len(live))
+        if self.metrics is not None:
+            self.metrics.log("membership", kind="mesh_shrunk",
+                             from_world=old, to_world=len(live))
+        self.log(f"elastic: mesh shrunk {old} -> {len(live)} workers; "
+                 "the next round recompiles at the new world size")
+        return True
+
     def train_round(self, batches):
         """One outer round. ``batches``: dict of arrays with leading axes
         (tau, global_batch, ...) — tau steps, batch dim sharded across
@@ -501,7 +598,7 @@ class LocalSGDSolver(Solver):
         dev = shard_batch(batches, self.mesh, self.axis, batch_dim=1)
         self.params, self.state, self.history, loss, aux = self._jit_round(
             self.params, self.state, self.history, dev,
-            jnp.asarray(self.iter, jnp.int32), key)
+            jnp.asarray(self.iter, jnp.int32), key, self._alive_mask())
         self.iter += self.tau
         host_s = _t.perf_counter() - t0
         self._timing["train_round"] += host_s
@@ -533,9 +630,17 @@ class LocalSGDSolver(Solver):
           * snapshot_every=N also snapshots every N completed rounds
           * an armed RecoveryPolicy (arm_recovery) rolls a NaN/exploding
             round back and redoes it instead of averaging poison
+          * an armed ElasticPolicy (arm_elastic) makes every round
+            quorum-based: sick workers are evicted from the consensus
+            and readmitted after a cooldown; QuorumLost (exit 4) aborts
+            the loop after a best-effort snapshot. With shrink_after
+            set, persistent evictions shrink the mesh over the
+            survivors — batch_fn must then size batches off
+            solver.mesh.shape (the live world).
         """
         from ..utils.signals import SignalPolicy
         from ..resilience import checkpoint
+        from ..resilience.elastic import QuorumLost
         prefix = snapshot_prefix or (self.param.snapshot_prefix
                                      if self.param.has("snapshot_prefix")
                                      else None)
@@ -555,7 +660,15 @@ class LocalSGDSolver(Solver):
                     scores = self.test(test_data_fn())
                     for k, v in scores.items():
                         self.log(f"round {r}: test {k} = {v}")
-                loss = self.train_round(batch_fn(self.tau))
+                try:
+                    loss = self.train_round(batch_fn(self.tau))
+                except QuorumLost:
+                    # the consensus up to here is good — keep it
+                    if prefix:
+                        self.snapshot(prefix=prefix)
+                    raise
+                if self.elastic is not None and self.elastic.should_shrink():
+                    self.shrink_to_survivors()
                 v = float(loss)
                 if self.watchdog is not None:
                     self.watchdog.beat(v)

@@ -21,12 +21,21 @@ diverging loss costs at most one sync round, never the run:
                  a round
   chaos.py       deterministic, seed-driven fault injectors (NaN at step k,
                  IO error with probability p, stall of s seconds, SIGTERM
-                 at round r) so every recovery path is exercised in CPU
-                 tests — armed via --chaos / SPARKNET_CHAOS
+                 at round r, worker crash at round r / with probability p)
+                 so every recovery path is exercised in CPU tests — armed
+                 via --chaos / SPARKNET_CHAOS
+  elastic.py     quorum-based sync rounds: a validity-masked consensus
+                 average inside the compiled round (a dead or NaN'd
+                 worker can't poison it) plus an ElasticPolicy that
+                 evicts sick workers, re-spreads their data shard over
+                 the survivors, readmits them after a cooldown, and
+                 aborts with QuorumLost / exit EXIT_QUORUM_LOST (4) when
+                 the live count drops below --quorum
 
 Everything reports through the run's MetricsLogger (events: checkpoint,
-recovery, retry, chaos), so `sparknet report` shows failures and the
-recoveries next to the loss curve they interrupted.
+recovery, retry, chaos, eviction, readmission, membership), so
+`sparknet report` shows failures and the recoveries next to the loss
+curve they interrupted.
 """
 
 from .checkpoint import (save_snapshot, find_resumable, resume_auto,
@@ -34,6 +43,9 @@ from .checkpoint import (save_snapshot, find_resumable, resume_auto,
 from .recovery import RecoveryPolicy, RecoveryAbort
 from .retry import RetryPolicy, RetryExhausted, retry_from_env
 from .chaos import ChaosMonkey, ChaosIOError, install_chaos, active_chaos
+from .elastic import (ElasticPolicy, QuorumLost, EXIT_QUORUM_LOST,
+                      masked_consensus, masked_consensus_stats,
+                      masked_scalar_mean, tree_finite, expand_to_slots)
 
 __all__ = [
     "save_snapshot", "find_resumable", "resume_auto", "load_manifest",
@@ -41,4 +53,7 @@ __all__ = [
     "RecoveryPolicy", "RecoveryAbort",
     "RetryPolicy", "RetryExhausted", "retry_from_env",
     "ChaosMonkey", "ChaosIOError", "install_chaos", "active_chaos",
+    "ElasticPolicy", "QuorumLost", "EXIT_QUORUM_LOST",
+    "masked_consensus", "masked_consensus_stats", "masked_scalar_mean",
+    "tree_finite", "expand_to_slots",
 ]

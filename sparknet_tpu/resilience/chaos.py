@@ -19,6 +19,13 @@ subsystem claims to survive — on a schedule tests can replay exactly:
   stall_repeat=1   stall at EVERY step >= K (a persistent straggler)
   sigterm_round=R  the process SIGTERMs itself after round/block R (once)
                    — exercises snapshot-then-stop + `--resume auto`
+  kill_worker=W, kill_round=R   mesh worker W "crashes" at sync round R
+                   (once; R defaults to 0) — exercises the elastic
+                   membership layer (resilience/elastic.py): eviction,
+                   shard re-spreading, quorum accounting, readmission
+  dead_p=P         each live worker independently crashes with
+                   probability P at every round (seeded rng; a crashed
+                   worker stays crashed until the policy readmits it)
 
 Armed via `--chaos "nan_step=30,io_p=0.02,seed=1"` or the SPARKNET_CHAOS
 env var (same spec), which data sources and solvers pick up through
@@ -62,6 +69,7 @@ class ChaosMonkey:
     def __init__(self, nan_step=None, nan_repeat=False, io_p=0.0,
                  stall_step=None, stall_s=0.0, stall_worker=None,
                  stall_repeat=False, sigterm_round=None,
+                 kill_worker=None, kill_round=0, dead_p=0.0,
                  seed=0, metrics=None, log_fn=print):
         self.nan_step = None if nan_step is None else int(nan_step)
         self.nan_repeat = bool(nan_repeat)
@@ -73,6 +81,11 @@ class ChaosMonkey:
         self._last_stall = None
         self.sigterm_round = None if sigterm_round is None \
             else int(sigterm_round)
+        self.kill_worker = None if kill_worker is None else int(kill_worker)
+        self.kill_round = int(kill_round)
+        self.dead_p = float(dead_p)
+        self._kill_fired = False
+        self._dead = set()      # workers dead_p has already crashed
         self._rng = np.random.RandomState(seed)
         self.metrics = metrics
         self.log = log_fn or (lambda *a: None)
@@ -99,7 +112,8 @@ class ChaosMonkey:
         known = {"nan_step": int, "nan_repeat": truthy, "io_p": float,
                  "stall_step": int, "stall_s": float,
                  "stall_worker": int, "stall_repeat": truthy,
-                 "sigterm_round": int, "seed": int}
+                 "sigterm_round": int, "kill_worker": int,
+                 "kill_round": int, "dead_p": float, "seed": int}
         unknown = set(fields) - set(known)
         if unknown:
             raise ValueError(f"unknown chaos keys {sorted(unknown)} "
@@ -154,6 +168,31 @@ class ChaosMonkey:
         injected straggler to a worker."""
         rep, self._last_stall = self._last_stall, None
         return rep
+
+    def dead_workers(self, round_, n_workers):
+        """Worker indices newly "crashed" at sync round ``round_`` —
+        the elastic membership layer evicts them (reason chaos_kill).
+        kill_worker fires once at kill_round; dead_p is a per-round,
+        per-worker seeded Bernoulli whose victims stay down (until the
+        policy readmits them — a replacement arriving)."""
+        out = []
+        if self.kill_worker is not None and not self._kill_fired \
+                and round_ >= self.kill_round:
+            self._kill_fired = True
+            if 0 <= self.kill_worker < n_workers:
+                self._event("kill_worker", worker=self.kill_worker,
+                            round=round_)
+                out.append(self.kill_worker)
+        if self.dead_p > 0:
+            for w in range(int(n_workers)):
+                if w in self._dead or w in out:
+                    continue
+                if self._rng.random_sample() < self.dead_p:
+                    self._dead.add(w)
+                    self._event("kill_worker", worker=w, round=round_,
+                                via="dead_p")
+                    out.append(w)
+        return out
 
     def maybe_sigterm(self, round_):
         if self.sigterm_round is not None and not self._term_fired \

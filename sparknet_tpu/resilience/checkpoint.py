@@ -201,18 +201,22 @@ def _legacy_pairs(prefix):
     return sorted(pairs, reverse=True)
 
 
-def find_resumable(prefix, log_fn=None):
+def find_resumable(prefix, log_fn=None, exclude=()):
     """Newest valid snapshot for ``prefix`` -> (state_path, skipped).
 
     skipped is [(state_path, reason), ...] for every newer snapshot that
     was refused (partial write, checksum mismatch, missing pair file).
     Returns (None, skipped) when nothing valid exists. Manifested
     snapshots are checksum-verified; legacy un-manifested pairs are only
-    checked for existence and non-emptiness.
+    checked for existence and non-emptiness. ``exclude``: state paths to
+    pass over even if they verify (resume_auto's fallback loop — a
+    snapshot that verified but then failed to restore, e.g. deleted by a
+    concurrent keep-N pruner between the check and the read).
     """
     log = log_fn or (lambda *a: None)
     skipped = []
     seen_states = set()
+    exclude = {os.path.basename(p) for p in exclude}
     d = os.path.dirname(prefix)
     man = load_manifest(prefix)
     for entry in reversed((man or {}).get("snapshots", [])):
@@ -220,6 +224,8 @@ def find_resumable(prefix, log_fn=None):
             continue
         state = os.path.join(d, entry.get("state") or "?")
         seen_states.add(os.path.basename(state))
+        if os.path.basename(state) in exclude:
+            continue
         reason = _verify_entry(d, entry)
         if reason is None:
             for s, r in skipped:
@@ -227,7 +233,8 @@ def find_resumable(prefix, log_fn=None):
             return state, skipped
         skipped.append((state, reason))
     for it, model, state in _legacy_pairs(prefix):
-        if os.path.basename(state) in seen_states:
+        if os.path.basename(state) in seen_states or \
+                os.path.basename(state) in exclude:
             continue            # manifest already ruled on this one
         if not os.path.exists(model):
             skipped.append((state, f"model file {model} is missing"))
@@ -271,17 +278,35 @@ def check_restorable(state_path):
 def resume_auto(solver, prefix, log_fn=None):
     """`--resume auto`: restore ``solver`` from the newest valid snapshot
     under ``prefix``; returns the state path used, or None (fresh start).
-    Every refused snapshot is logged with its reason."""
+    Every refused snapshot is logged with its reason.
+
+    find_resumable's verification and the actual restore are two reads —
+    a retention race (keep-N pruning in a concurrent writer, an external
+    cleaner) can delete the manifested files in between, and a manifest
+    can outlive files a crashed pruner already removed. A snapshot that
+    verified but fails to RESTORE is therefore logged with the reason
+    and excluded, and the search falls back to the next valid one
+    instead of killing the relaunch."""
     log = log_fn or (lambda *a: None)
-    state, skipped = find_resumable(prefix, log_fn=log)
-    if state is None:
-        log(f"resume auto: no resumable snapshot under {prefix!r}"
-            + (f" ({len(skipped)} refused)" if skipped else "")
-            + "; starting fresh")
-        return None
-    solver.restore(state)
-    log(f"resume auto: restored iter {solver.iter} from {state}")
-    if getattr(solver, "metrics", None) is not None:
-        solver.metrics.log("checkpoint", kind="resume", iter=solver.iter,
-                           state=state, refused=len(skipped))
-    return state
+    tried = []
+    while True:
+        state, skipped = find_resumable(prefix, log_fn=log, exclude=tried)
+        if state is None:
+            refused = len(skipped) + len(tried)
+            log(f"resume auto: no resumable snapshot under {prefix!r}"
+                + (f" ({refused} refused)" if refused else "")
+                + "; starting fresh")
+            return None
+        try:
+            solver.restore(state)
+        except (OSError, ValueError, KeyError) as e:
+            log(f"refusing snapshot {state}: restore failed ({e}); "
+                "falling back to the next valid snapshot")
+            tried.append(state)
+            continue
+        log(f"resume auto: restored iter {solver.iter} from {state}")
+        if getattr(solver, "metrics", None) is not None:
+            solver.metrics.log("checkpoint", kind="resume",
+                               iter=solver.iter, state=state,
+                               refused=len(skipped) + len(tried))
+        return state

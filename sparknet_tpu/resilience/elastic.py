@@ -1,0 +1,357 @@
+"""Elastic membership: quorum-based sync rounds that survive worker loss.
+
+The sync round inherited from the paper is all-or-nothing — the params
+(or gradient) average includes every mesh slot on the data axis, so one
+dead or NaN'd worker poisons the consensus and stalls the run. PR 3's
+sensors can *name* a sick worker (straggler, loss skew,
+worker_nonfinite); this module is the layer that *acts* on it, in the
+spirit of sync-SGD-with-backup-workers (Chen et al. 2016,
+arXiv:1604.00981) and elastic runtimes (TorchElastic, Elastic Horovod).
+
+Two halves, like obs/divergence.py:
+
+device half (pure jnp, called inside shard_map by the sharded solvers):
+
+  masked_consensus        validity-masked weighted average across the
+                          axis: each worker contributes iff its host-
+                          declared alive bit AND its on-device finite
+                          check hold; weights renormalize over the live
+                          count. BIT-FOR-BIT equal to ``lax.pmean`` when
+                          every worker is valid (`jnp.where` keeps dead
+                          workers' NaNs out of the psum entirely —
+                          ``NaN * 0`` would still be NaN).
+  masked_consensus_stats  the same average plus the divergence aux of
+                          obs/divergence.consensus_stats, with dead
+                          workers excluded from the drift statistics and
+                          a ``valid``/``n_live`` membership report.
+  tree_finite             scalar "all leaves finite" — the device-side
+                          validity bit, so a worker whose replica went
+                          non-finite mid-round can never poison the
+                          consensus even before the host reacts.
+
+host half:
+
+  ElasticPolicy   per-round membership controller: consumes the fetched
+                  membership aux (per-worker validity, losses) plus the
+                  chaos ``kill_worker``/``dead_p`` injectors, evicts a
+                  worker after ``evict_after`` consecutive invalid
+                  rounds (per-worker ``eviction`` records in the
+                  metrics stream), readmits it after a
+                  ``readmit_after``-round cooldown (the replicated
+                  consensus weights ARE the re-broadcast — every slot,
+                  dead or alive, leaves the round holding them), and
+                  raises QuorumLost when the live count would drop
+                  below ``quorum`` — the CLI maps that to exit code
+                  EXIT_QUORUM_LOST (4), documented in DEPLOY.md.
+  expand_to_slots re-partition helper: lay batches drawn for the LIVE
+                  workers back onto the full slot grid (dead slots get
+                  a survivor's copy, which the device mask discards) —
+                  the sampler/shard_batch path only pays for data that
+                  will actually be consumed.
+
+Eviction is an input (the (n,) alive mask) to the already-compiled
+round, so membership changes cost zero recompiles; when an eviction is
+persistent, ``LocalSGDSolver.shrink_to_survivors()`` optionally rebuilds
+the mesh over the live devices (one recompile) so dead slots stop
+burning compute.
+"""
+
+import numpy as np
+
+
+EXIT_QUORUM_LOST = 4
+
+
+class QuorumLost(RuntimeError):
+    """Live worker count fell below the quorum — the run cannot make a
+    trustworthy consensus anymore. The CLI exits EXIT_QUORUM_LOST (4);
+    see the DEPLOY.md supervisor runbook."""
+
+
+# -- device half (inside shard_map) ----------------------------------------
+
+def tree_finite(tree):
+    """Replicated-per-worker bool scalar: every leaf of ``tree`` is
+    finite everywhere. One elementwise pass, no collectives."""
+    import jax
+    import jax.numpy as jnp
+    ok = jnp.bool_(True)
+    for leaf in jax.tree_util.tree_leaves(tree):
+        ok = jnp.logical_and(ok, jnp.all(jnp.isfinite(
+            jnp.asarray(leaf, jnp.float32))))
+    return ok
+
+
+def _live_scale(valid, axis):
+    """(n_live, scale) for a masked average: scale = n/max(n_live, 1),
+    EXACTLY 1.0f when every worker is valid (n/n with small ints exact
+    in f32), so `pmean(masked) * scale` is bit-for-bit `pmean(x)` in the
+    all-valid case no matter how the backend lowers pmean's division."""
+    import jax
+    import jax.numpy as jnp
+    from ..parallel.compat import axis_size
+    n = axis_size(axis)
+    n_live = jax.lax.psum(jnp.asarray(valid, jnp.float32), axis)
+    scale = jnp.float32(n) / jnp.maximum(n_live, jnp.float32(1))
+    return n_live, scale
+
+
+def masked_consensus(tree, valid, axis):
+    """Validity-masked average of ``tree`` across ``axis`` (inside
+    shard_map). ``valid``: this worker's f32 0/1 scalar. Returns
+    (consensus, n_live); the consensus is replicated (same on every
+    worker, dead ones included — that replication is the readmission
+    re-broadcast for free).
+
+    All-valid bit-for-bit contract: ``where(True, x, 0) == x`` exactly,
+    and the renormalization scale n/n_live is exactly 1.0, so the value
+    is the plain ``pmean`` bit-for-bit — the same pmean the collective
+    always was, not a reimplementation that could round differently.
+    Dead workers are excluded with ``jnp.where`` — a multiplicative
+    mask would leak their NaNs (NaN*0 == NaN)."""
+    import jax
+    import jax.numpy as jnp
+    n_live, scale = _live_scale(valid, axis)
+    keep = valid > 0
+
+    def one(x):
+        x = jnp.asarray(x)
+        m = jax.lax.pmean(jnp.where(keep, x, jnp.zeros_like(x)), axis)
+        return m * scale.astype(m.dtype)
+
+    return jax.tree_util.tree_map(one, tree), n_live
+
+
+def masked_scalar_mean(x, valid, axis):
+    """Masked mean of one replicated-output scalar (e.g. the round
+    loss): dead workers' NaNs stay out of the displayed value. Same
+    all-valid bit-for-bit contract as masked_consensus."""
+    import jax
+    import jax.numpy as jnp
+    x = jnp.asarray(x, jnp.float32)
+    _, scale = _live_scale(valid, axis)
+    return jax.lax.pmean(jnp.where(valid > 0, x, jnp.float32(0)),
+                         axis) * scale
+
+
+def masked_consensus_stats(tree, valid, axis):
+    """masked_consensus + the divergence aux of
+    obs/divergence.consensus_stats, dead workers excluded from the
+    drift statistics (their distance to consensus is garbage). The aux
+    additionally carries the membership report:
+
+      valid    (N,) all_gather of each worker's effective validity
+      n_live   live count the average renormalized over
+    """
+    import jax
+    import jax.numpy as jnp
+    from ..obs.divergence import tree_sq_dist
+    consensus, n_live = masked_consensus(tree, valid, axis)
+    per_layer, local_sq = tree_sq_dist(tree, consensus)
+    keep = valid > 0
+    local_sq = jnp.where(keep, local_sq, jnp.float32(0))
+    aux = {
+        "div_mean_sq": masked_scalar_mean(local_sq, valid, axis),
+        "div_max_sq": jax.lax.pmax(local_sq, axis),
+        "div_worker_sq": jax.lax.all_gather(local_sq, axis),
+        "layer_div_sq": {k: masked_scalar_mean(v, valid, axis)
+                         for k, v in per_layer.items()},
+        "valid": jax.lax.all_gather(jnp.asarray(valid, jnp.float32), axis),
+        "n_live": n_live,
+    }
+    return consensus, aux
+
+
+# -- host half -------------------------------------------------------------
+
+def expand_to_slots(shards, owners):
+    """Re-partition helper: ``shards`` is a list/array of per-LIVE-worker
+    batch shards (worker-major); ``owners[slot]`` indexes into it for
+    every mesh slot (identity-ish for live slots, a survivor for dead
+    ones — see ElasticPolicy.shard_owners). Returns the full-slot-grid
+    array the compiled round expects; dead slots' copies are discarded
+    by the device mask, so only live shards carry fresh data."""
+    shards = [np.asarray(s) for s in shards]
+    return np.stack([shards[o] for o in owners])
+
+
+class ElasticPolicy:
+    """Membership controller for one sharded solver.
+
+    observe_round(round_idx, valid=..., worker_loss=...) once per
+    materialized sync round:
+
+      * chaos ``kill_worker``/``dead_p`` injections evict immediately
+        (the simulated crash — reason "chaos_kill")
+      * an alive worker whose device validity bit was 0 (non-finite
+        replica) for ``evict_after`` consecutive observed rounds is
+        evicted (reason "nonfinite")
+      * an evicted worker is readmitted after ``readmit_after`` rounds
+        (0 disables readmission); the consensus weights every slot
+        already holds are its restart state
+      * if the live count would drop below ``quorum``, QuorumLost is
+        raised (after logging a ``membership`` quorum_lost event)
+
+    Every eviction/readmission logs a per-worker ``eviction`` /
+    ``readmission`` metrics event, so `sparknet report` and
+    `sparknet monitor` can render the membership history.
+    """
+
+    def __init__(self, n_workers, quorum=1, evict_after=2, readmit_after=5,
+                 shrink_after=0, metrics=None, log_fn=print, chaos=None):
+        self.n = int(n_workers)
+        if self.n < 1:
+            raise ValueError("elastic membership needs >= 1 worker")
+        self.quorum = max(1, int(quorum))
+        if self.quorum > self.n:
+            raise ValueError(f"quorum {self.quorum} exceeds world size "
+                             f"{self.n}")
+        self.evict_after = max(1, int(evict_after))
+        self.readmit_after = max(0, int(readmit_after))
+        # >0: after this many consecutive rounds with ANY eviction in
+        # force, suggest shrinking the mesh (the solver acts on it)
+        self.shrink_after = max(0, int(shrink_after))
+        self.metrics = metrics
+        self.log = log_fn or (lambda *a: None)
+        self.chaos = chaos
+        self.alive = np.ones(self.n, bool)
+        self.evictions = []             # [{worker, round, reason}, ...]
+        self.readmissions = []          # [{worker, round}, ...]
+        self._bad_streak = np.zeros(self.n, np.int64)
+        self._evicted_at = {}           # worker -> eviction round
+        self._degraded_rounds = 0       # consecutive rounds not at full n
+        self.quorum_lost = False
+
+    # -- views -------------------------------------------------------------
+    def live(self):
+        """Sorted indices of live workers."""
+        return [int(w) for w in np.nonzero(self.alive)[0]]
+
+    def live_count(self):
+        return int(self.alive.sum())
+
+    def alive_f32(self):
+        """The (n,) host alive mask the compiled round consumes."""
+        return self.alive.astype(np.float32)
+
+    def shard_owners(self):
+        """For every mesh slot, the index (into the LIVE-ordered shard
+        list) of the shard that fills it: live slots own their shard in
+        live order; dead slots borrow a survivor's round-robin — see
+        data/sampler.partition_owners and expand_to_slots."""
+        from ..data.sampler import partition_owners
+        owner_worker = partition_owners(self.n, self.alive)
+        live = self.live()
+        rank = {w: i for i, w in enumerate(live)}
+        return [rank[int(w)] for w in owner_worker]
+
+    def summary(self):
+        return {"world": self.n, "live": self.live_count(),
+                "quorum": self.quorum,
+                "evictions": list(self.evictions),
+                "readmissions": list(self.readmissions),
+                "quorum_lost": self.quorum_lost}
+
+    # -- membership transitions --------------------------------------------
+    def evict(self, worker, round_idx, reason):
+        w = int(worker)
+        if not (0 <= w < self.n) or not self.alive[w]:
+            return False
+        if self.live_count() - 1 < self.quorum:
+            self._quorum_lost(round_idx, would_evict=w, reason=reason)
+        self.alive[w] = False
+        self._bad_streak[w] = 0
+        self._evicted_at[w] = round_idx
+        rec = {"worker": w, "round": round_idx, "reason": reason,
+               "live": self.live_count()}
+        self.evictions.append(rec)
+        self.log(f"elastic: EVICTED worker {w} at round {round_idx} "
+                 f"({reason}); {self.live_count()}/{self.n} live, "
+                 f"shard re-spread over survivors")
+        if self.metrics is not None:
+            self.metrics.log("eviction", **rec)
+        return True
+
+    def readmit(self, worker, round_idx):
+        w = int(worker)
+        if not (0 <= w < self.n) or self.alive[w]:
+            return False
+        self.alive[w] = True
+        self._bad_streak[w] = 0
+        self._evicted_at.pop(w, None)
+        rec = {"worker": w, "round": round_idx, "live": self.live_count()}
+        self.readmissions.append(rec)
+        self.log(f"elastic: readmitted worker {w} at round {round_idx} "
+                 f"from the consensus weights; "
+                 f"{self.live_count()}/{self.n} live")
+        if self.metrics is not None:
+            self.metrics.log("readmission", **rec)
+        return True
+
+    def _quorum_lost(self, round_idx, **fields):
+        self.quorum_lost = True
+        if self.metrics is not None:
+            self.metrics.log("membership", kind="quorum_lost",
+                             round=round_idx, live=self.live_count(),
+                             quorum=self.quorum, **fields)
+        self.log(f"elastic: QUORUM LOST at round {round_idx}: "
+                 f"{self.live_count()} live, need {self.quorum}")
+        raise QuorumLost(
+            f"live workers would drop below quorum {self.quorum} "
+            f"at round {round_idx} (exit {EXIT_QUORUM_LOST})")
+
+    # -- the per-round controller ------------------------------------------
+    def observe_round(self, round_idx, valid=None, worker_loss=None):
+        """Feed one materialized round's membership signals. ``valid``:
+        the (n,) effective validity vector fetched from the compiled
+        round (host mask AND device finite bit). Raises QuorumLost when
+        an eviction (or a chaos kill) would break the quorum. Returns
+        True when membership changed (the caller may want to re-spread
+        data or shrink)."""
+        changed = False
+        if self.chaos is not None and hasattr(self.chaos, "dead_workers"):
+            for w in self.chaos.dead_workers(round_idx, self.n):
+                changed |= self.evict(w, round_idx, "chaos_kill")
+        if valid is not None:
+            v = np.asarray(valid, np.float64).ravel()[:self.n]
+            for w in range(len(v)):
+                if not self.alive[w]:
+                    continue
+                if v[w] > 0:
+                    self._bad_streak[w] = 0
+                    continue
+                self._bad_streak[w] += 1
+                if self._bad_streak[w] >= self.evict_after:
+                    reason = "nonfinite"
+                    if worker_loss is not None:
+                        wl = np.asarray(worker_loss, np.float64).ravel()
+                        if w < len(wl) and not np.isfinite(wl[w]):
+                            reason = f"nonfinite loss ({wl[w]})"
+                    changed |= self.evict(w, round_idx, reason)
+        if self.readmit_after:
+            for w, r0 in sorted(self._evicted_at.items()):
+                if round_idx - r0 >= self.readmit_after:
+                    changed |= self.readmit(w, round_idx)
+        self._degraded_rounds = self._degraded_rounds + 1 \
+            if self.live_count() < self.n else 0
+        return changed
+
+    def should_shrink(self):
+        """True when evictions have been in force long enough that the
+        solver should rebuild its mesh over the survivors (shrink_after
+        rounds; 0 disables)."""
+        return bool(self.shrink_after) and \
+            self._degraded_rounds >= self.shrink_after and \
+            self.live_count() < self.n
+
+    def reset_world(self, n_workers):
+        """After a mesh shrink: the survivors ARE the new world."""
+        self.n = int(n_workers)
+        self.quorum = min(self.quorum, self.n)
+        self.alive = np.ones(self.n, bool)
+        self._bad_streak = np.zeros(self.n, np.int64)
+        self._evicted_at = {}
+        self._degraded_rounds = 0
+        if self.metrics is not None:
+            self.metrics.log("membership", kind="world_reset",
+                             live=self.n)

@@ -104,10 +104,13 @@ class Solver:
         self.watchdog = None
         # resilience hooks (sparknet_tpu.resilience): keep-N snapshot
         # retention (None = keep all), an optional RecoveryPolicy armed via
-        # arm_recovery(), and the process-wide chaos injector (None unless
-        # --chaos / SPARKNET_CHAOS armed one)
+        # arm_recovery(), an optional ElasticPolicy armed via arm_elastic()
+        # (quorum-based sync rounds on sharded solvers), and the
+        # process-wide chaos injector (None unless --chaos /
+        # SPARKNET_CHAOS armed one)
         self.snapshot_keep = None
         self.recovery = None
+        self.elastic = None
         from ..resilience.chaos import active_chaos
         self.chaos = active_chaos()
         train_np, test_np = resolve_nets(solver_param, base_dir, net_param)
@@ -367,6 +370,55 @@ class Solver:
         policy.note_good(self)
         return policy
 
+    def arm_elastic(self, policy=None, **kw):
+        """Install an elastic membership controller
+        (resilience/elastic.py): the sync collectives become validity-
+        masked quorum averages, sick workers are evicted/readmitted,
+        and dropping below ``quorum`` raises QuorumLost (exit 4). Only
+        sharded solvers (a data-axis mesh) act on it; arming rebuilds
+        the compiled step/round so the membership aux is traced in."""
+        mesh = getattr(self, "mesh", None)
+        axis = getattr(self, "axis", None)
+        n = mesh.shape[axis] if mesh is not None and axis in mesh.shape \
+            else 1
+        if policy is None:
+            from ..resilience.elastic import ElasticPolicy
+            kw.setdefault("metrics", self.metrics)
+            kw.setdefault("log_fn", self.log)
+            kw.setdefault("chaos", self.chaos)
+            policy = ElasticPolicy(n_workers=n, **kw)
+        self.elastic = policy
+        self._jit_train = None
+        if hasattr(self, "_jit_round"):
+            self._jit_round = None
+        return policy
+
+    def _alive_mask(self):
+        """The (n,) f32 alive mask the compiled step/round consumes —
+        all ones without elastic membership, which keeps the masked
+        average bit-for-bit the plain pmean."""
+        n = self.mesh.shape[self.axis]
+        if self.elastic is not None:
+            return jnp.asarray(self.elastic.alive_f32())
+        return jnp.ones((n,), jnp.float32)
+
+    def _observe_membership(self, aux, round_idx=None):
+        """Feed the elastic membership controller one materialized
+        round's validity/loss vectors. QuorumLost propagates — the run
+        must stop — but nothing else may kill training."""
+        if self.elastic is None or not aux:
+            return
+        from ..resilience.elastic import QuorumLost
+        try:
+            self.elastic.observe_round(
+                round_idx if round_idx is not None else self.iter - 1,
+                valid=aux.get("valid"),
+                worker_loss=aux.get("worker_loss"))
+        except QuorumLost:
+            raise
+        except Exception as e:
+            self.log(f"elastic membership observation failed: {e!r}")
+
     def scale_lr(self, factor):
         """Scale the lr schedule by ``factor`` from now on. The schedule
         is traced into the compiled step, so the jitted programs are
@@ -445,13 +497,29 @@ class Solver:
 
     def _observe_sync_round(self, aux, round_s=None, round_idx=None):
         """Fetch one sync round's on-device aux stats (a few scalars),
-        emit the ``divergence`` event, and feed the health detectors.
-        Called by _obs_step at sample points (per-step solvers) or once
-        per round (LocalSGDSolver). Never raises into the step loop."""
-        if self.divergence is None or not aux:
+        feed the elastic membership controller, emit the ``divergence``
+        event, and feed the health detectors. Called by _obs_step at
+        sample points (per-step solvers) or once per round
+        (LocalSGDSolver). Only QuorumLost — the membership verdict that
+        the run must stop — escapes into the step loop."""
+        if not aux:
             return None
         try:
             aux = jax.device_get(aux)
+        except Exception as e:          # monitoring must never kill a run
+            self.log(f"sync-round aux fetch failed: {e!r}")
+            return None
+        # membership first: eviction decisions (and the QuorumLost
+        # abort) must not depend on the metrics stream being armed.
+        # The health detectors below still judge this round against the
+        # membership that was IN FORCE while it ran — a worker evicted
+        # or readmitted just now must not alarm against the new mask.
+        alive_during_round = self.elastic.alive.copy() \
+            if self.elastic is not None else None
+        self._observe_membership(aux, round_idx)
+        if self.divergence is None:
+            return None
+        try:
             d = self.divergence.observe(
                 self.iter - 1, aux, kind=aux.get("kind", "params"),
                 tau=getattr(self, "tau", None), round_idx=round_idx)
@@ -462,7 +530,8 @@ class Solver:
                     worker_losses=aux.get("worker_loss"),
                     latencies=self._round_latencies(round_s)
                     if round_s is not None else None,
-                    divergence=d)
+                    divergence=d, valid=aux.get("valid"),
+                    alive=alive_during_round)
             return d
         except Exception as e:          # monitoring must never kill a run
             self.log(f"divergence observation failed: {e!r}")
@@ -495,6 +564,15 @@ class Solver:
                                      **self.health.summary())
             finally:
                 self.health = None
+        if self.elastic is not None:
+            try:
+                if self.metrics is not None and \
+                        (self.elastic.evictions or
+                         self.elastic.readmissions):
+                    self.metrics.log("membership", kind="summary",
+                                     **self.elastic.summary())
+            finally:
+                self.elastic = None
         self.divergence = self.memstats = None
         if self.stepstats is not None:
             try:

@@ -84,6 +84,30 @@ class TestMinibatchSampler:
         s = MinibatchSampler(batches, 5, 5, rng=np.random.RandomState(0))
         assert [b["i"] for b in s] == [0, 1, 2, 3, 4]
 
+    def test_short_stream_raises_clear_error(self):
+        """A stream shorter than total_num_batches must not surface as
+        a bare StopIteration (silently-short window / PEP 479
+        RuntimeError in generators) — it names expected vs actual."""
+        batches = [{"i": i} for i in range(3)]       # lies: claims 10
+        s = MinibatchSampler(batches, 10, 4, rng=np.random.RandomState(3))
+        assert s.start + 4 > 3                       # window needs more
+        with pytest.raises(ValueError) as ei:
+            list(s)
+        msg = str(ei.value)
+        assert "exhausted after 3 batches" in msg
+        assert "total_num_batches=10" in msg
+
+    def test_short_stream_error_inside_generator(self):
+        """Inside a generator (the prefetch path), the old bare
+        StopIteration would have become an opaque RuntimeError."""
+        def feed():
+            s = MinibatchSampler(iter([{"i": 0}]), 8, 3,
+                                 rng=np.random.RandomState(0))
+            for b in s:
+                yield b
+        with pytest.raises(ValueError, match="exhausted"):
+            list(feed())
+
 
 def make_cifar_solver(log_fn=None, **overrides):
     # cifar10_full_solver.prototxt schedule, shrunk for test runtime

@@ -1,0 +1,532 @@
+"""Elastic local SGD tests (sparknet_tpu.resilience.elastic, ISSUE 4).
+
+The contract under test: every sync round is quorum-based instead of
+all-or-nothing. With all workers valid the masked consensus average is
+BIT-FOR-BIT the previous pmean path; a chaos-killed or NaN'd worker is
+excluded on device, evicted by the host policy (with an ``eviction``
+event in the metrics stream), its data shard re-spreads over the
+survivors, it is readmitted from the consensus weights after the
+cooldown; dropping below --quorum aborts with QuorumLost and the CLI
+maps that to the documented exit code 4.
+"""
+
+import io
+import json
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from sparknet_tpu.proto import Message
+from sparknet_tpu.utils.metrics import MetricsLogger
+from sparknet_tpu.parallel import (LocalSGDSolver, DataParallelSolver,
+                                   make_mesh)
+from sparknet_tpu.parallel.compat import shard_map
+from sparknet_tpu.resilience import ChaosMonkey
+from sparknet_tpu.resilience.elastic import (
+    ElasticPolicy, QuorumLost, EXIT_QUORUM_LOST, masked_consensus,
+    masked_consensus_stats, masked_scalar_mean, tree_finite,
+    expand_to_slots)
+from sparknet_tpu.data.sampler import partition_owners
+
+
+def events_of(buf):
+    return [json.loads(line) for line in buf.getvalue().splitlines()]
+
+
+def sink():
+    buf = io.StringIO()
+    return MetricsLogger(stream=buf), buf
+
+
+def mlp_net(batch=8, dim=16, classes=4):
+    net = Message("NetParameter", name="mlp")
+    net.add("layer", name="d", type="JavaData", top=["data"],
+            java_data_param=dict(shape=dict(dim=[batch, dim])))
+    net.add("layer", name="l", type="JavaData", top=["label"],
+            java_data_param=dict(shape=dict(dim=[batch])))
+    net.add("layer", name="fc", type="InnerProduct", bottom=["data"],
+            top=["fc"], inner_product_param=dict(
+                num_output=classes, weight_filler=dict(type="xavier")))
+    net.add("layer", name="loss", type="SoftmaxWithLoss",
+            bottom=["fc", "label"], top=["loss"])
+    return net
+
+
+def lsgd(workers=4, tau=2, metrics=None, batch=8):
+    sp = Message("SolverParameter", base_lr=0.05, lr_policy="fixed",
+                 random_seed=0, display=0)
+    return LocalSGDSolver(sp, net_param=mlp_net(batch=batch),
+                          metrics=metrics, mesh=make_mesh({"data": workers}),
+                          tau=tau, log_fn=None)
+
+
+def round_batches(tau=2, workers=4, batch=8, seed=0):
+    rs = np.random.RandomState(seed)
+    return {"data": rs.randn(tau, workers * batch, 16).astype(np.float32),
+            "label": rs.randint(0, 4, (tau, workers * batch))
+            .astype(np.int32)}
+
+
+def tree_bytes_equal(a, b):
+    for lname in a:
+        for i, x in enumerate(a[lname]):
+            assert np.asarray(x).tobytes() == \
+                np.asarray(b[lname][i]).tobytes(), lname
+
+
+# -------------------------------------------- device half: bit-for-bit ----
+
+class TestMaskedConsensus:
+    @pytest.mark.parametrize("n", [2, 3, 4, 5, 8])
+    def test_all_valid_is_bitwise_pmean(self, n):
+        """The acceptance contract: with every worker valid, the masked
+        average IS the old pmean, bit for bit — including world sizes
+        whose 1/n is inexact in f32 (3, 5)."""
+        mesh = make_mesh({"data": n})
+        rs = np.random.RandomState(1)
+        tree = {"fc": [rs.randn(n, 4, 3).astype(np.float32)]}
+
+        def f(t, alive):
+            w = jax.lax.axis_index("data")
+            masked, n_live = masked_consensus(t, alive[w], "data")
+            scalar = masked_scalar_mean(jnp.sum(t["fc"][0]),
+                                        alive[w], "data")
+            return (masked, jax.lax.pmean(t, "data"), n_live, scalar,
+                    jax.lax.pmean(jnp.sum(t["fc"][0]), "data"))
+
+        g = jax.jit(shard_map(
+            f, mesh=mesh, in_specs=({"fc": [P("data")]}, P()),
+            out_specs=(P(),) * 5, check_vma=False))
+        masked, plain, n_live, ms, ps = g(tree, jnp.ones(n, jnp.float32))
+        assert np.asarray(masked["fc"][0]).tobytes() == \
+            np.asarray(plain["fc"][0]).tobytes()
+        assert np.asarray(ms).tobytes() == np.asarray(ps).tobytes()
+        assert float(n_live) == n
+
+    def test_nan_worker_never_poisons_consensus(self):
+        """A dead worker's NaN replica stays out of the psum entirely
+        (where-mask, not multiply — NaN*0 is still NaN) and the average
+        renormalizes over the survivors."""
+        n = 4
+        mesh = make_mesh({"data": n})
+        tree = {"fc": [np.ones((n, 2), np.float32)]}
+        tree["fc"][0][1, :] = np.nan
+        tree["fc"][0][0, :] = 3.0
+        alive = np.ones(n, np.float32)
+        alive[1] = 0.0
+
+        def f(t, alive):
+            w = jax.lax.axis_index("data")
+            valid = alive[w] * tree_finite(t).astype(jnp.float32)
+            return masked_consensus(t, valid, "data")
+
+        g = jax.jit(shard_map(
+            f, mesh=mesh, in_specs=({"fc": [P("data")]}, P()),
+            out_specs=(P(), P()), check_vma=False))
+        c, n_live = g(tree, jnp.asarray(alive))
+        v = np.asarray(c["fc"][0])
+        assert np.isfinite(v).all()
+        assert float(n_live) == n - 1
+        np.testing.assert_allclose(v, (3.0 + 1.0 + 1.0) / 3)
+
+    def test_device_finite_bit_masks_without_host_mask(self):
+        """Even with the host mask all ones, a worker whose replica went
+        non-finite is excluded by its own finite bit — the first line
+        of defense, before any host round trip."""
+        n = 2
+        mesh = make_mesh({"data": n})
+        tree = {"fc": [np.asarray([[1.0, 1.0], [np.inf, 1.0]],
+                                  np.float32)]}
+
+        def f(t, alive):
+            w = jax.lax.axis_index("data")
+            valid = alive[w] * tree_finite(t).astype(jnp.float32)
+            c, n_live = masked_consensus(t, valid, "data")
+            return c, n_live, jax.lax.all_gather(valid, "data")
+
+        g = jax.jit(shard_map(
+            f, mesh=mesh, in_specs=({"fc": [P("data")]}, P()),
+            out_specs=(P(), P(), P()), check_vma=False))
+        c, n_live, valid = g(tree, jnp.ones(n, jnp.float32))
+        np.testing.assert_allclose(np.asarray(c["fc"][0]), 1.0)
+        assert float(n_live) == 1
+        np.testing.assert_allclose(np.asarray(valid).ravel(), [1.0, 0.0])
+
+    def test_masked_stats_report_membership(self):
+        n = 4
+        mesh = make_mesh({"data": n})
+        rs = np.random.RandomState(0)
+        tree = {"fc": [rs.randn(n, 3).astype(np.float32)]}
+        alive = np.ones(n, np.float32)
+        alive[2] = 0.0
+
+        def f(t, alive):
+            w = jax.lax.axis_index("data")
+            return masked_consensus_stats(t, alive[w], "data")
+
+        g = jax.jit(shard_map(
+            f, mesh=mesh, in_specs=({"fc": [P("data")]}, P()),
+            out_specs=(P(), P()), check_vma=False))
+        _, aux = g(tree, jnp.asarray(alive))
+        np.testing.assert_allclose(np.asarray(aux["valid"]).ravel(),
+                                   alive)
+        assert float(aux["n_live"]) == 3
+        # the dead worker's drift is zeroed, not NaN/garbage
+        per = np.asarray(aux["div_worker_sq"]).ravel()
+        assert per[2] == 0.0 and np.isfinite(per).all()
+
+
+# ------------------------------------------------ e2e: solver threading ----
+
+class TestElasticLocalSGD:
+    def test_all_valid_rounds_bit_identical_with_elastic_armed(self):
+        """Regression for the acceptance criterion: arming elasticity
+        (mask plumbing, validity bits, membership aux) changes NOTHING
+        when no worker is evicted — params bit-for-bit across rounds."""
+        rounds = [round_batches(seed=s) for s in range(3)]
+        plain = lsgd()
+        for b in rounds:
+            plain.train_round({k: v.copy() for k, v in b.items()})
+        el = lsgd()
+        el.arm_elastic(quorum=1)
+        for b in rounds:
+            el.train_round({k: v.copy() for k, v in b.items()})
+        assert el.elastic.live_count() == 4
+        tree_bytes_equal(plain.params, el.params)
+
+    def test_chaos_kill_evicts_completes_and_readmits(self):
+        """The headline scenario: a chaos-killed worker mid-run ->
+        training completes on the survivors with finite weights, an
+        ``eviction`` event lands in the metrics JSONL, and the worker is
+        readmitted after the cooldown."""
+        ms, buf = sink()
+        s = lsgd(metrics=ms)
+        s.chaos = ChaosMonkey(kill_worker=1, kill_round=2, log_fn=None,
+                              metrics=ms)
+        s.arm_elastic(quorum=2, evict_after=1, readmit_after=3,
+                      chaos=s.chaos)
+        for r in range(8):
+            loss = s.train_round(round_batches(seed=r))
+        assert np.isfinite(float(loss))
+        for plist in s.params.values():
+            for p in plist:
+                assert np.isfinite(np.asarray(p)).all()
+        s.close()
+        evs = events_of(buf)
+        ev = [e for e in evs if e["event"] == "eviction"]
+        assert ev and ev[0]["worker"] == 1 and ev[0]["reason"] == \
+            "chaos_kill" and ev[0]["round"] == 2
+        rd = [e for e in evs if e["event"] == "readmission"]
+        assert rd and rd[0]["worker"] == 1 and rd[0]["round"] == 5
+        # the chaos injection itself is on the record too
+        assert any(e["event"] == "chaos" and e.get("kind") == "kill_worker"
+                   for e in evs)
+        # divergence events report the degraded live count while evicted
+        assert any(e.get("live") == 3 for e in evs
+                   if e["event"] == "divergence")
+        # and the round loss during the outage reflects survivors only
+        assert all(np.isfinite(e.get("mean", 0.0)) for e in evs
+                   if e["event"] == "divergence")
+
+    def test_nonfinite_worker_evicted_after_streak(self):
+        """A worker whose shard feeds NaNs: the device mask excludes it
+        the same round (finite final consensus) and the host policy
+        evicts after evict_after consecutive invalid rounds, with
+        worker_masked health alarms naming it."""
+        ms, buf = sink()
+        s = lsgd(metrics=ms)
+        s.arm_elastic(quorum=2, evict_after=2, readmit_after=0)
+        s.arm_health(cooldown=1)
+        for r in range(4):
+            b = round_batches(seed=r)
+            b["data"][:, 8:16] = np.nan       # worker 1's slice
+            loss = s.train_round(b)
+        assert np.isfinite(float(loss))
+        for plist in s.params.values():
+            for p in plist:
+                assert np.isfinite(np.asarray(p)).all()
+        assert s.elastic.evictions and \
+            s.elastic.evictions[0]["worker"] == 1
+        s.close()
+        evs = events_of(buf)
+        masked = [e for e in evs if e["event"] == "health"
+                  and e["kind"] == "worker_masked"]
+        assert masked and all(e["worker"] == 1 for e in masked)
+        assert any(e["event"] == "eviction" and
+                   "nonfinite" in e["reason"] for e in evs)
+
+    def test_quorum_lost_raises(self):
+        s = lsgd(workers=2)
+        s.chaos = ChaosMonkey(kill_worker=0, kill_round=1, log_fn=None)
+        s.arm_elastic(quorum=2, evict_after=1, chaos=s.chaos)
+        with pytest.raises(QuorumLost, match="quorum 2"):
+            for r in range(4):
+                s.train_round(round_batches(workers=2, seed=r))
+        assert s.elastic.quorum_lost
+
+    def test_dead_p_kills_deterministically(self):
+        ms, buf = sink()
+        s = lsgd(metrics=ms)
+        s.chaos = ChaosMonkey(dead_p=0.35, seed=7, log_fn=None)
+        s.arm_elastic(quorum=1, evict_after=1, readmit_after=0,
+                      chaos=s.chaos)
+        for r in range(6):
+            loss = s.train_round(round_batches(seed=r))
+        assert np.isfinite(float(loss))
+        n_evicted = len(s.elastic.evictions)
+        assert 1 <= n_evicted <= 3       # seeded: some but not all die
+        s.close()
+        assert sum(1 for e in events_of(buf)
+                   if e["event"] == "eviction") == n_evicted
+
+    def test_mesh_shrink_recompiles_on_survivors(self):
+        ms, buf = sink()
+        s = lsgd(metrics=ms)
+        s.chaos = ChaosMonkey(kill_worker=3, kill_round=1, log_fn=None)
+        s.arm_elastic(quorum=2, evict_after=1, readmit_after=0,
+                      shrink_after=2, chaos=s.chaos)
+        for r in range(4):
+            s.train_round(round_batches(seed=r))
+        assert s.elastic.should_shrink()
+        assert s.shrink_to_survivors()
+        assert s.mesh.shape["data"] == 3
+        assert s.elastic.live_count() == 3       # world reset
+        # the shrunk world trains on (tau, 3*batch) feeds
+        loss = s.train_round(round_batches(workers=3, seed=99))
+        assert np.isfinite(float(loss))
+        s.close()
+        evs = events_of(buf)
+        assert any(e["event"] == "membership" and
+                   e.get("kind") == "mesh_shrunk" and
+                   e["from_world"] == 4 and e["to_world"] == 3
+                   for e in evs)
+
+
+class TestElasticDataParallel:
+    def test_masked_gradient_pmean_evicts_nan_shard(self):
+        """The DataParallelSolver side: a corrupt shard's NaN gradients
+        are masked out of the per-step allreduce (params stay finite)
+        and the policy evicts the shard after its streak."""
+        sp = Message("SolverParameter", base_lr=0.05, lr_policy="fixed",
+                     random_seed=0, display=0)
+        d = DataParallelSolver(sp, net_param=mlp_net(batch=32),
+                               mesh=make_mesh({"data": 4}), log_fn=None)
+        d.arm_elastic(quorum=2, evict_after=2, readmit_after=0)
+        rs = np.random.RandomState(0)
+        for it in range(5):
+            b = {"data": rs.randn(32, 16).astype(np.float32),
+                 "label": rs.randint(0, 4, 32).astype(np.int32)}
+            b["data"][8:16] = np.nan      # worker 1's shard
+            loss = d.train_step(b)
+        assert np.isfinite(float(loss))
+        for plist in d.params.values():
+            for p in plist:
+                assert np.isfinite(np.asarray(p)).all()
+        assert d.elastic.evictions and \
+            d.elastic.evictions[0]["worker"] == 1
+
+    def test_all_valid_steps_bit_identical_with_elastic_armed(self):
+        sp = dict(base_lr=0.05, lr_policy="fixed", random_seed=0,
+                  display=0)
+        rs = np.random.RandomState(3)
+        steps = [{"data": rs.randn(32, 16).astype(np.float32),
+                  "label": rs.randint(0, 4, 32).astype(np.int32)}
+                 for _ in range(3)]
+        plain = DataParallelSolver(Message("SolverParameter", **sp),
+                                   net_param=mlp_net(batch=32),
+                                   mesh=make_mesh({"data": 4}),
+                                   log_fn=None)
+        for b in steps:
+            plain.train_step(dict(b))
+        el = DataParallelSolver(Message("SolverParameter", **sp),
+                                net_param=mlp_net(batch=32),
+                                mesh=make_mesh({"data": 4}), log_fn=None)
+        el.arm_elastic(quorum=1)
+        for b in steps:
+            el.train_step(dict(b))
+        tree_bytes_equal(plain.params, el.params)
+
+
+# ------------------------------------------------- host policy (unit) ----
+
+class TestElasticPolicy:
+    def test_evict_after_streak_and_reset_on_recovery(self):
+        ms, buf = sink()
+        p = ElasticPolicy(4, quorum=1, evict_after=3, readmit_after=0,
+                          metrics=ms, log_fn=None)
+        p.observe_round(0, valid=[1, 0, 1, 1])
+        p.observe_round(1, valid=[1, 1, 1, 1])      # recovered: reset
+        p.observe_round(2, valid=[1, 0, 1, 1])
+        p.observe_round(3, valid=[1, 0, 1, 1])
+        assert p.live_count() == 4                  # streak 2 < 3
+        p.observe_round(4, valid=[1, 0, 1, 1])
+        assert p.live_count() == 3 and not p.alive[1]
+        ev = [e for e in events_of(buf) if e["event"] == "eviction"]
+        assert len(ev) == 1 and ev[0]["worker"] == 1 \
+            and ev[0]["round"] == 4
+
+    def test_readmit_after_cooldown(self):
+        p = ElasticPolicy(3, evict_after=1, readmit_after=2, log_fn=None)
+        p.evict(2, 0, "test")
+        p.observe_round(1)
+        assert not p.alive[2]
+        p.observe_round(2)
+        assert p.alive[2]
+        assert p.readmissions[0]["worker"] == 2
+
+    def test_quorum_guard_raises_before_evicting(self):
+        p = ElasticPolicy(2, quorum=2, evict_after=1, log_fn=None)
+        with pytest.raises(QuorumLost):
+            p.evict(0, 5, "test")
+        assert p.quorum_lost and p.live_count() == 2  # nothing evicted
+
+    def test_quorum_validation(self):
+        with pytest.raises(ValueError, match="quorum"):
+            ElasticPolicy(2, quorum=3)
+
+    def test_shard_owners_round_robin(self):
+        p = ElasticPolicy(4, evict_after=1, log_fn=None)
+        p.evict(1, 0, "t")
+        # live order [0, 2, 3]; dead slot 1 borrows live rank 0
+        assert p.shard_owners() == [0, 0, 1, 2]
+
+    def test_alive_mask_dtype(self):
+        p = ElasticPolicy(3, log_fn=None)
+        m = p.alive_f32()
+        assert m.dtype == np.float32 and m.tolist() == [1.0, 1.0, 1.0]
+
+
+class TestReSharding:
+    def test_partition_owners(self):
+        np.testing.assert_array_equal(
+            partition_owners(4, [True, False, True, False]), [0, 0, 2, 2])
+        np.testing.assert_array_equal(
+            partition_owners(3, [True, True, True]), [0, 1, 2])
+        # round-robin over survivors when several slots are dead
+        np.testing.assert_array_equal(
+            partition_owners(5, [False, True, False, True, False]),
+            [1, 1, 3, 3, 1])
+
+    def test_partition_owners_errors(self):
+        with pytest.raises(ValueError, match="no live workers"):
+            partition_owners(2, [False, False])
+        with pytest.raises(ValueError, match="entries"):
+            partition_owners(3, [True, True])
+
+    def test_expand_to_slots(self):
+        shards = [np.full((2, 3), i, np.float32) for i in range(3)]
+        full = expand_to_slots(shards, [0, 0, 1, 2])
+        assert full.shape == (4, 2, 3)
+        np.testing.assert_array_equal(full[1], shards[0])
+        np.testing.assert_array_equal(full[3], shards[2])
+
+
+# ------------------------------------------- CLI / report / monitor ----
+
+class TestElasticSurfaces:
+    def test_quorum_lost_exit_code_is_4(self, monkeypatch):
+        assert EXIT_QUORUM_LOST == 4
+
+        class BoomApp:
+            def __init__(self, **kw):
+                self.solver = None
+                self.metrics = None
+
+            def run(self, **kw):
+                raise QuorumLost("2 live < quorum 3")
+
+        import sparknet_tpu.apps as apps
+        monkeypatch.setattr(apps, "CifarApp", BoomApp)
+        from sparknet_tpu.cli import main
+        rc = main(["cifar", "--workers", "2", "--rounds", "1"])
+        assert rc == EXIT_QUORUM_LOST
+
+    def test_cli_elastic_flags_arm_policy(self):
+        import argparse
+        from sparknet_tpu.cli import _apply_elastic_flags
+        s = lsgd()
+        args = argparse.Namespace(quorum=2, evict_after=None,
+                                  readmit_after=7)
+        _apply_elastic_flags(s, args)
+        assert s.elastic is not None
+        assert s.elastic.quorum == 2
+        assert s.elastic.evict_after == 2      # default
+        assert s.elastic.readmit_after == 7
+        s.close()
+        # no flags -> no policy
+        s2 = lsgd()
+        _apply_elastic_flags(s2, argparse.Namespace(
+            quorum=0, evict_after=None, readmit_after=None))
+        assert s2.elastic is None
+        s2.close()
+
+    def test_report_renders_elasticity(self):
+        from sparknet_tpu.obs import report as obs_report
+        evs = [
+            {"event": "eviction", "worker": 1, "round": 3,
+             "reason": "chaos_kill", "live": 3},
+            {"event": "eviction", "worker": 2, "round": 5,
+             "reason": "nonfinite", "live": 2},
+            {"event": "readmission", "worker": 1, "round": 8, "live": 3},
+            {"event": "membership", "kind": "quorum_lost", "round": 9,
+             "live": 1, "quorum": 2},
+        ]
+        rep = obs_report.aggregate(evs)
+        el = rep["elasticity"]
+        assert el["evictions"] == 2 and el["readmissions"] == 1
+        assert el["evictions_by_worker"] == {"1": 1, "2": 1}
+        assert el["min_live"] == 1
+        assert el["quorum_lost"]["quorum"] == 2
+        text = obs_report.render(rep)
+        assert "elastic membership: 2 eviction(s), 1 readmission(s)" \
+            in text
+        assert "evicted worker 1 at round 3: chaos_kill" in text
+        assert "QUORUM LOST at round 9" in text
+
+    def test_monitor_folds_membership(self):
+        from sparknet_tpu.obs.monitor import MonitorState
+        st = MonitorState()
+        st.update({"event": "eviction", "worker": 1, "round": 2,
+                   "reason": "chaos_kill", "live": 3})
+        st.update({"event": "readmission", "worker": 1, "round": 7,
+                   "live": 4})
+        text = st.render("x.jsonl")
+        assert "membership: 4 live  evictions 1 (w1:1)" in text
+        assert "readmissions 1" in text
+        assert "last eviction: worker 1 round 2 (chaos_kill)" in text
+        st.update({"event": "membership", "kind": "quorum_lost",
+                   "live": 1, "quorum": 2})
+        assert "QUORUM LOST: 1 live < quorum 2" in st.render("x")
+
+
+# -------------------------------------------------- chaos spec (unit) ----
+
+class TestKillChaos:
+    def test_parse_kill_spec(self):
+        m = ChaosMonkey.parse("kill_worker=2,kill_round=5,dead_p=0.1",
+                              log_fn=None)
+        assert m.kill_worker == 2 and m.kill_round == 5
+        assert m.dead_p == 0.1
+
+    def test_kill_worker_fires_once(self):
+        m = ChaosMonkey(kill_worker=1, kill_round=3, log_fn=None)
+        assert m.dead_workers(2, 4) == []
+        assert m.dead_workers(3, 4) == [1]
+        assert m.dead_workers(4, 4) == []
+
+    def test_dead_p_is_permanent_and_seeded(self):
+        a = ChaosMonkey(dead_p=0.5, seed=11, log_fn=None)
+        b = ChaosMonkey(dead_p=0.5, seed=11, log_fn=None)
+        seq_a = [a.dead_workers(r, 4) for r in range(4)]
+        seq_b = [b.dead_workers(r, 4) for r in range(4)]
+        assert seq_a == seq_b
+        dead = {w for round_ in seq_a for w in round_}
+        assert len(dead) == len([w for r in seq_a for w in r])  # no dupes
+
+    def test_out_of_range_kill_worker_ignored(self):
+        m = ChaosMonkey(kill_worker=9, kill_round=0, log_fn=None)
+        assert m.dead_workers(0, 4) == []
+        assert m.dead_workers(1, 4) == []       # fired (once), no victim

@@ -195,6 +195,76 @@ class TestAtomicCheckpoint:
         assert resume_auto(s, str(tmp_path / "none")) is None
         assert s.iter == 0
 
+    def test_resume_auto_falls_back_when_manifested_files_deleted(
+            self, tmp_path):
+        """The retention/manifest race: keep-N pruning (or an external
+        cleaner) deleted the snapshot the manifest still references —
+        resume_auto must fall back to the next valid snapshot with a
+        stated reason, not die on the relaunch."""
+        s = _solver()
+        prefix = str(tmp_path / "race")
+        data = _toy_batches(16)
+        s.train_step(next(data))
+        _, good_state = s.snapshot(prefix)
+        s.train_step(next(data))
+        model2, state2 = s.snapshot(prefix)
+        # the race: files gone, manifest entry still present
+        os.remove(model2)
+        os.remove(state2)
+        man = load_manifest(prefix)
+        assert any(e["state"] == os.path.basename(state2)
+                   for e in man["snapshots"])
+        logs = []
+        s2 = _solver()
+        used = resume_auto(s2, prefix, log_fn=logs.append)
+        assert used == good_state
+        assert s2.iter == 1
+        assert any("missing" in m for m in logs)    # the stated reason
+
+    def test_resume_auto_falls_back_when_restore_itself_fails(
+            self, tmp_path, monkeypatch):
+        """TOCTOU half of the race: the snapshot verifies, then the
+        files vanish (concurrent pruner) between find_resumable's check
+        and the restore read — fall back, don't crash."""
+        s = _solver()
+        prefix = str(tmp_path / "toctou")
+        data = _toy_batches(16)
+        s.train_step(next(data))
+        _, state1 = s.snapshot(prefix)
+        s.train_step(next(data))
+        _, state2 = s.snapshot(prefix)
+
+        s2 = _solver()
+        real_restore = s2.restore
+
+        def racy_restore(path):
+            if path == state2:          # deleted between check and read
+                raise OSError(f"{path}: vanished mid-restore")
+            return real_restore(path)
+
+        monkeypatch.setattr(s2, "restore", racy_restore)
+        logs = []
+        used = resume_auto(s2, prefix, log_fn=logs.append)
+        assert used == state1
+        assert s2.iter == 1
+        assert any("restore failed" in m and "falling back" in m
+                   for m in logs)
+
+    def test_resume_auto_fresh_start_when_every_restore_fails(
+            self, tmp_path, monkeypatch):
+        s = _solver()
+        prefix = str(tmp_path / "allgone")
+        s.train_step(next(_toy_batches(16)))
+        s.snapshot(prefix)
+        s2 = _solver()
+        monkeypatch.setattr(
+            s2, "restore",
+            lambda path: (_ for _ in ()).throw(OSError("gone")))
+        logs = []
+        assert resume_auto(s2, prefix, log_fn=logs.append) is None
+        assert s2.iter == 0
+        assert any("starting fresh" in m for m in logs)
+
 
 # ------------------------------------------------------------- recovery ----
 
@@ -275,6 +345,38 @@ class TestRetry:
         with pytest.raises(RetryExhausted, match="budget"):
             pol.call(always, where="t")
         assert pol.retries_used == 4            # 3 allowed + the fatal one
+
+    def test_budget_spans_multiple_record_failure_call_sites(self):
+        """The budget is a LIFETIME bound: failures booked directly via
+        record_failure from different call-sites (a DB cursor restart
+        here, a file read there) draw from the same pool, even though
+        each site's per-call ``attempt`` counter stays low."""
+        pol = RetryPolicy(attempts=10, budget=3, sleep=lambda s: None)
+        pol.record_failure(OSError("a"), attempt=1, where="cursor")
+        pol.record_failure(OSError("b"), attempt=1, where="file")
+        pol.record_failure(OSError("c"), attempt=2, where="cursor")
+        assert pol.retries_used == 3
+        with pytest.raises(RetryExhausted, match="retry budget"):
+            pol.record_failure(OSError("d"), attempt=1, where="third")
+        assert pol.retries_used == 4
+        # once spent, EVERY site is shut down, first attempt included
+        with pytest.raises(RetryExhausted, match="retry budget"):
+            pol.record_failure(OSError("e"), attempt=1, where="fourth")
+
+    def test_delay_never_negative_at_max_jitter(self):
+        """delay() must never hand time.sleep a negative number, even
+        with jitter >= 1 where base*(1 + jitter*uniform(-1,1)) can cross
+        zero."""
+        for jitter in (0.5, 1.0, 2.0):
+            pol = RetryPolicy(attempts=8, base_s=0.05, max_s=2.0,
+                              jitter=jitter, seed=123,
+                              sleep=lambda s: None)
+            delays = [pol.delay(a) for a in range(1, 9)] * 50
+            assert all(d >= 0.0 for d in delays), (jitter, min(delays))
+        # and the exponential cap still holds without jitter
+        pol = RetryPolicy(base_s=0.05, max_s=2.0, jitter=0.0)
+        assert pol.delay(1) == pytest.approx(0.05)
+        assert pol.delay(20) == pytest.approx(2.0)
 
     def test_db_source_survives_injected_io_errors(self, tmp_path):
         from sparknet_tpu.data.lmdb import LMDBWriter
