@@ -8,6 +8,11 @@ cd "$(dirname "$0")/.."
 python -m compileall -q sparknet_tpu || exit 1
 echo "compileall OK"
 
+# static analysis: JAX hazard rules + lock-discipline checker, strict
+# mode (any non-baselined finding fails the build — scripts/lint.sh)
+bash scripts/lint.sh || exit 1
+echo "sparknet lint OK"
+
 set -o pipefail
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu \

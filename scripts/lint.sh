@@ -1,0 +1,12 @@
+#!/usr/bin/env bash
+# Static analysis gate: `sparknet lint --strict` over the package
+# source with the committed baseline. Exits non-zero on ANY
+# non-baselined finding, stale baseline entry, or baseline entry
+# without a written justification (see README "Static analysis").
+# jax-free: runs on any checkout, no accelerator stack needed.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+python -m sparknet_tpu lint --strict \
+    --baseline .sparknet-lint-baseline.json \
+    --root . sparknet_tpu

@@ -1,0 +1,40 @@
+"""`sparknet lint` — JAX-aware static analysis for the codebase's own
+bug classes.
+
+The runtime machinery of PRs 1-4 (obs, resilience, elastic rounds)
+catches failures while they happen; this subsystem catches the bug
+classes this codebase is most exposed to *before* anything runs, in the
+spirit of always-on program-analysis platforms (Tricorder, Sadowski et
+al., ICSE 2015): build the analyzers once, run them on every commit.
+
+Two analyzer families over a shared AST engine (engine.py):
+
+  jax_rules.py     SPK1xx — compiled-code hazards: host syncs reachable
+                   from jit/pmap/shard_map roots (which would erase the
+                   local-SGD comms savings the SparkNet design exists
+                   to deliver), recompile hazards, PRNG-key reuse,
+                   collective axis-name mismatches, missing buffer
+                   donation in update loops.
+  thread_rules.py  SPK2xx — lock discipline for the threaded host side
+                   (watchdog, metrics, prefetch, monitor): fields
+                   annotated ``# spk: guarded-by=<lock>`` are flagged
+                   when read/written outside a ``with <lock>:`` block
+                   in any method reachable from a thread entry point.
+
+Findings can be suppressed inline (``# spk: disable=CODE``) or accepted
+into a committed baseline file with a written justification
+(baseline.py), so legacy findings never block CI while new ones do.
+CLI: ``sparknet lint [--strict] [paths...]`` (cli.py) — wired into
+scripts/lint.sh / scripts/ci.sh / .github/workflows/ci.yml.
+
+Import discipline: nothing in this package imports jax (or any other
+heavyweight dependency) — linting runs on checkout hosts with no
+accelerator stack, exactly like ``sparknet monitor``.
+"""
+
+from .engine import (Finding, Module, LintEngine, lint_paths,
+                     all_rules, ALL_CODES)
+from .baseline import Baseline
+
+__all__ = ["Finding", "Module", "LintEngine", "lint_paths",
+           "all_rules", "ALL_CODES", "Baseline"]

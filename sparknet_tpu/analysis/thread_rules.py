@@ -1,0 +1,368 @@
+"""SPK2xx — lock-discipline race checker for the threaded host side.
+
+The solver loop shares host state with the watchdog monitor thread,
+the prefetch workers, the metrics logger and the live monitor's tailer.
+The discipline is annotation-driven, GuardedBy-style (ErrorProne /
+Tricorder lineage):
+
+  self._last = 0.0          # spk: guarded-by=_lock
+
+declares that ``self._last`` may only be touched inside a
+``with self._lock:`` block. A class-wide default exists for state
+holders whose every field is shared:
+
+  class MonitorState:
+      # spk: guarded-by-default=_lock
+
+(every field assigned in ``__init__`` becomes guarded, except the lock
+itself, sync primitives, and lines annotated ``# spk: unguarded``).
+
+Thread entry points are methods passed as ``target=self.m`` to
+``threading.Thread`` plus methods annotated ``# spk: thread-entry``
+(for cross-object handoffs the checker cannot see, e.g. a closure in
+another function calling ``state.update``); reachability closes over
+``self.m()`` calls.
+
+Rules:
+  SPK201 (error)  guarded field accessed without its lock in a method
+                  reachable from a thread entry point — a data race
+  SPK202 (warn)   guarded field accessed without its lock elsewhere
+                  (the main-thread side of the same race; __init__ and
+                  __del__ are exempt — the object isn't shared yet)
+  SPK203 (warn)   guarded-by names a lock the class never creates —
+                  a stale annotation to fix or narrow
+  SPK204 (warn)   a field written both by thread-reachable and other
+                  methods with no guarded-by at all — the checker's
+                  "you have an unannotated shared field" tripwire
+
+Known scope limits, on purpose: accesses through aliases
+(``x = self.f``) and from *outside* the class are not tracked — the
+annotation contract is that shared fields are touched via methods.
+"""
+
+import ast
+import re
+
+from .engine import (rule, make_finding, SEVERITY_ERROR, SEVERITY_WARN)
+
+_GUARD_RE = re.compile(r"#\s*spk:\s*guarded-by\s*=\s*(\w+)")
+_GUARD_DEFAULT_RE = re.compile(r"#\s*spk:\s*guarded-by-default\s*=\s*(\w+)")
+_UNGUARDED_RE = re.compile(r"#\s*spk:\s*unguarded\b")
+_THREAD_ENTRY_RE = re.compile(r"#\s*spk:\s*thread-entry\b")
+_HOLDS_RE = re.compile(r"#\s*spk:\s*holds\s*=\s*(\w+)")
+
+_LOCK_CTORS = {"Lock", "RLock", "Condition"}
+_SYNC_CTORS = _LOCK_CTORS | {"Event", "Semaphore", "BoundedSemaphore",
+                             "Barrier", "Queue", "LifoQueue",
+                             "PriorityQueue", "SimpleQueue",
+                             "local", "Thread"}
+
+
+def _ctor_basename(value):
+    node = value
+    while isinstance(node, ast.Call):
+        f = node.func
+        if isinstance(f, ast.Attribute):
+            return f.attr
+        if isinstance(f, ast.Name):
+            return f.id
+        return None
+    return None
+
+
+class ClassInfo:
+    """Everything SPK201-204 need to know about one class."""
+
+    def __init__(self, module, node):
+        self.module = module
+        self.node = node
+        self.name = node.name
+        self.guards = {}          # field -> lock attr name
+        self.unguarded = set()    # fields explicitly opted out
+        self.locks = set()        # lock attrs the class creates
+        self.sync_fields = set()  # Lock/Event/Queue/... fields
+        self.methods = {}         # name -> FunctionDef
+        self.entries = set()      # thread entry method names
+        self.holds = {}           # method -> lock it requires held
+        self.guard_lines = {}     # field -> annotation line (for SPK203)
+        self._collect()
+
+    def _collect(self):
+        default_guard = None
+        for i in range(self.node.lineno,
+                       self._end_line() + 1):
+            m = _GUARD_DEFAULT_RE.search(self.module.line_text(i))
+            if m:
+                default_guard = m.group(1)
+                break
+        for item in self.node.body:
+            if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.methods[item.name] = item
+                if _THREAD_ENTRY_RE.search(
+                        self.module.line_text(item.lineno)):
+                    self.entries.add(item.name)
+                hm = _HOLDS_RE.search(self.module.line_text(item.lineno))
+                if hm:
+                    self.holds[item.name] = hm.group(1)
+        # field discovery: every `self.X = ...` in any method (guards
+        # usually sit in __init__ but setters re-assign too)
+        for mname, mnode in self.methods.items():
+            for n in ast.walk(mnode):
+                if not isinstance(n, ast.Assign):
+                    continue
+                for t in n.targets:
+                    if not (isinstance(t, ast.Attribute)
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id == "self"):
+                        continue
+                    field = t.attr
+                    text = self.module.line_text(n.lineno)
+                    ctor = _ctor_basename(n.value)
+                    if ctor in _LOCK_CTORS:
+                        self.locks.add(field)
+                    if ctor in _SYNC_CTORS:
+                        self.sync_fields.add(field)
+                    gm = _GUARD_RE.search(text)
+                    if gm:
+                        self.guards[field] = gm.group(1)
+                        self.guard_lines.setdefault(field, n.lineno)
+                    elif _UNGUARDED_RE.search(text):
+                        self.unguarded.add(field)
+                    elif default_guard and mname == "__init__" \
+                            and field != default_guard \
+                            and ctor not in _SYNC_CTORS:
+                        self.guards.setdefault(field, default_guard)
+                        self.guard_lines.setdefault(field, n.lineno)
+        self.unguarded -= set(self.guards)
+        for f in self.unguarded:
+            self.guards.pop(f, None)
+
+    def _end_line(self):
+        return getattr(self.node, "end_lineno", self.node.lineno)
+
+    def thread_reachable(self):
+        """Method names reachable from the thread entry points via
+        self.m() calls (the intra-class call graph)."""
+        reach = set(self.entries)
+        changed = True
+        while changed:
+            changed = False
+            for name in list(reach):
+                mnode = self.methods.get(name)
+                if mnode is None:
+                    continue
+                for n in ast.walk(mnode):
+                    if isinstance(n, ast.Call) \
+                            and isinstance(n.func, ast.Attribute) \
+                            and isinstance(n.func.value, ast.Name) \
+                            and n.func.value.id == "self" \
+                            and n.func.attr in self.methods \
+                            and n.func.attr not in reach:
+                        reach.add(n.func.attr)
+                        changed = True
+        return reach
+
+
+def _classes(module):
+    cache = getattr(module, "_thread_classes", None)
+    if cache is not None:
+        return cache
+    cache = []
+    for node in ast.walk(module.tree):
+        if isinstance(node, ast.ClassDef):
+            cache.append(ClassInfo(module, node))
+    # `target=self._run` thread creations can appear anywhere in the
+    # module (even another class/function); attribute them by method
+    # name to every class defining that method
+    targets = set()
+    for node in ast.walk(module.tree):
+        if isinstance(node, ast.Call):
+            f = node.func
+            name = f.attr if isinstance(f, ast.Attribute) else (
+                f.id if isinstance(f, ast.Name) else None)
+            if name != "Thread":
+                continue
+            for kw in node.keywords:
+                if kw.arg == "target" and \
+                        isinstance(kw.value, ast.Attribute) and \
+                        isinstance(kw.value.value, ast.Name) and \
+                        kw.value.value.id == "self":
+                    targets.add(kw.value.attr)
+    for ci in cache:
+        ci.entries |= {t for t in targets if t in ci.methods}
+    module._thread_classes = cache
+    return cache
+
+
+def _held_locks_walk(method, visit, initial_held=frozenset()):
+    """Walk ``method``'s body tracking the set of self.<lock> names
+    held via `with self.<lock>:` blocks; calls visit(node, held) on
+    every node. Nested function defs inherit the held set at their
+    definition point only if they are immediately-invoked — otherwise
+    they run later on an unknown thread, so they get an empty held set
+    (conservative for closures handed to Thread(target=...))."""
+
+    def lock_names(withnode):
+        names = []
+        for item in withnode.items:
+            e = item.context_expr
+            if isinstance(e, ast.Attribute) and \
+                    isinstance(e.value, ast.Name) and e.value.id == "self":
+                names.append(e.attr)
+        return names
+
+    def walk(node, held):
+        visit(node, held)
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            inner = held | set(lock_names(node))
+            for item in node.items:
+                walk(item.context_expr, held)
+                if item.optional_vars:
+                    walk(item.optional_vars, held)
+            for b in node.body:
+                walk(b, inner)
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)) and node is not method:
+            body = node.body if isinstance(node.body, list) \
+                else [node.body]
+            for b in body:
+                walk(b, frozenset())
+            return
+        for child in ast.iter_child_nodes(node):
+            walk(child, held)
+
+    for b in method.body:
+        walk(b, frozenset(initial_held))
+
+
+@rule("SPK201", "lock-discipline", SEVERITY_ERROR)
+def lock_discipline(module, ctx):
+    """Guarded field accessed outside its `with <lock>:` block in a
+    method reachable from a thread entry point — two threads can be in
+    here at once, so this is a data race on the annotated field."""
+    yield from _guard_findings(module, reachable_only=True,
+                               fn=lock_discipline)
+
+
+@rule("SPK202", "lock-discipline-main", SEVERITY_WARN)
+def lock_discipline_main(module, ctx):
+    """Guarded field accessed outside its lock in a method NOT on any
+    thread path — the main-thread half of the same race (the other
+    thread can still interleave). __init__/__del__ are exempt: the
+    object isn't shared yet/anymore."""
+    yield from _guard_findings(module, reachable_only=False,
+                               fn=lock_discipline_main)
+
+
+def _guard_findings(module, reachable_only, fn):
+    for ci in _classes(module):
+        if not ci.guards:
+            continue
+        reach = ci.thread_reachable()
+        for mname, mnode in ci.methods.items():
+            if mname in ("__init__", "__del__"):
+                continue
+            in_reach = mname in reach
+            if reachable_only != in_reach:
+                continue
+            hits = []
+
+            def visit(node, held, _hits=hits, _ci=ci):
+                if isinstance(node, ast.Attribute) and \
+                        isinstance(node.value, ast.Name) and \
+                        node.value.id == "self" and \
+                        node.attr in _ci.guards and \
+                        _ci.guards[node.attr] not in held:
+                    _hits.append((node, node.attr,
+                                  _ci.guards[node.attr], "field"))
+                # calling a `# spk: holds=<lock>` helper without the
+                # lock breaks its contract just like a naked access
+                if isinstance(node, ast.Call) and \
+                        isinstance(node.func, ast.Attribute) and \
+                        isinstance(node.func.value, ast.Name) and \
+                        node.func.value.id == "self" and \
+                        node.func.attr in _ci.holds and \
+                        _ci.holds[node.func.attr] not in held:
+                    _hits.append((node, node.func.attr,
+                                  _ci.holds[node.func.attr], "holds"))
+
+            # `# spk: holds=<lock>` on the def line: a private helper
+            # whose contract is "only called with <lock> held" — the
+            # checker trusts the annotation and verifies the callers
+            # (they must wrap the call in `with self.<lock>:`)
+            held0 = set()
+            hm = _HOLDS_RE.search(module.line_text(mnode.lineno))
+            if hm:
+                held0.add(hm.group(1))
+            _held_locks_walk(mnode, visit, initial_held=held0)
+            for node, what, lock, kind in hits:
+                where = "thread-reachable " if reachable_only else ""
+                noun = "field" if kind == "field" else \
+                    "lock-requiring helper"
+                verb = "accessed" if kind == "field" else "called"
+                yield make_finding(
+                    fn, module,
+                    f"{noun} `{what}` (guarded-by `{lock}`) "
+                    f"{verb} without holding `self.{lock}` in "
+                    f"{where}method `{ci.name}.{mname}`",
+                    node=node, symbol=f"{ci.name}.{mname}")
+
+
+@rule("SPK203", "stale-guard-annotation", SEVERITY_WARN)
+def stale_guard_annotation(module, ctx):
+    """A guarded-by annotation names a lock attribute the class never
+    creates (threading.Lock/RLock/Condition assignment) — either the
+    lock was renamed/removed or the annotation should be narrowed
+    away."""
+    for ci in _classes(module):
+        for field, lock in sorted(ci.guards.items()):
+            if lock not in ci.locks:
+                yield make_finding(
+                    stale_guard_annotation, module,
+                    f"field `{field}` is guarded-by `{lock}` but "
+                    f"`{ci.name}` never creates `self.{lock}` as a "
+                    "Lock/RLock/Condition",
+                    line=ci.guard_lines.get(field, ci.node.lineno),
+                    symbol=f"{ci.name}")
+
+
+@rule("SPK204", "unannotated-shared-write", SEVERITY_WARN)
+def unannotated_shared_write(module, ctx):
+    """A field written both from a thread-reachable method and from a
+    non-thread method, with no guarded-by annotation: the checker can't
+    prove anything about it, and that pattern is exactly how the
+    watchdog's `_last` race looked. Annotate it (and lock the
+    accesses) or mark it `# spk: unguarded` with a reason."""
+    for ci in _classes(module):
+        if not ci.entries:
+            continue
+        reach = ci.thread_reachable()
+        writes_in, writes_out = {}, {}
+        for mname, mnode in ci.methods.items():
+            if mname in ("__init__", "__del__"):
+                continue
+            sink = writes_in if mname in reach else writes_out
+            for n in ast.walk(mnode):
+                targets = []
+                if isinstance(n, ast.Assign):
+                    targets = n.targets
+                elif isinstance(n, (ast.AugAssign, ast.AnnAssign)):
+                    targets = [n.target]
+                for t in targets:
+                    if isinstance(t, ast.Attribute) and \
+                            isinstance(t.value, ast.Name) and \
+                            t.value.id == "self":
+                        sink.setdefault(t.attr, n)
+        for field in sorted(set(writes_in) & set(writes_out)):
+            if field in ci.guards or field in ci.unguarded \
+                    or field in ci.sync_fields:
+                continue
+            node = writes_in[field]
+            yield make_finding(
+                unannotated_shared_write, module,
+                f"field `{field}` of `{ci.name}` is written both from "
+                "thread-reachable and main-thread methods with no "
+                "guarded-by annotation — annotate it (spk: guarded-by="
+                "<lock>) or mark it `spk: unguarded` with a reason",
+                node=node, symbol=f"{ci.name}")

@@ -805,6 +805,15 @@ def _apply_health_flags(solver, args):
         arm_recovery=args.health_arm_recovery)
 
 
+def cmd_lint(args):
+    """JAX-aware static analysis (sparknet_tpu.analysis): host-sync /
+    recompile / PRNG-reuse / collective-axis hazards in compiled code
+    plus the guarded-by lock-discipline race checker for the threaded
+    host side. No jax import — runs on any checkout."""
+    from .analysis.cli import run_lint
+    return run_lint(args)
+
+
 def cmd_imagenet(args):
     from .apps import ImageNetApp
     app = ImageNetApp(num_workers=args.workers, strategy=args.strategy,
@@ -1071,6 +1080,46 @@ def main(argv=None):
     mo.add_argument("--duration", type=float, default=None,
                     help="stop after this many seconds (default: forever)")
     mo.set_defaults(fn=cmd_monitor)
+
+    li = sub.add_parser(
+        "lint",
+        help="static analysis: JAX hazard rules (host syncs/recompiles/"
+             "PRNG reuse/axis mismatches in jitted code) + the "
+             "guarded-by lock-discipline race checker")
+    li.add_argument("paths", nargs="*",
+                    help="files/directories to lint (default: the "
+                         "sparknet_tpu package source)")
+    li.add_argument("--strict", action="store_true",
+                    help="exit 1 on ANY non-baselined finding (warnings "
+                         "included), stale baseline entries, or "
+                         "baseline entries without a justification — "
+                         "the CI mode (scripts/lint.sh)")
+    li.add_argument("--baseline",
+                    help="baseline file (default: "
+                         ".sparknet-lint-baseline.json next to the lint "
+                         "root, then CWD)")
+    li.add_argument("--write-baseline", action="store_true",
+                    help="accept the current findings into the baseline "
+                         "(new entries need --justification; stale "
+                         "entries expire)")
+    li.add_argument("--justification",
+                    help="justification text recorded on entries newly "
+                         "added by --write-baseline")
+    li.add_argument("--select", metavar="CODES",
+                    help="comma-separated rule codes to run "
+                         "(e.g. SPK101,SPK201)")
+    li.add_argument("--root", help="directory finding paths are "
+                                   "reported relative to (default: "
+                                   "CWD, or the package parent when "
+                                   "linting the default target)")
+    li.add_argument("--json", action="store_true",
+                    help="machine-readable findings on stdout")
+    li.add_argument("-v", "--verbose", action="store_true",
+                    help="also print baselined findings with their "
+                         "justifications")
+    li.add_argument("--list-rules", action="store_true",
+                    help="print the rule table and exit")
+    li.set_defaults(fn=cmd_lint)
 
     i = sub.add_parser("imagenet", help="ImageNetApp driver")
     i.add_argument("--workers", type=int, default=None)

@@ -17,15 +17,25 @@ import collections
 import json
 import os
 import sys
+import threading
 import time
 
 from .report import MetricsFileError, _fmt_bytes, _num
 
 
 class MonitorState:
-    """Fold metrics events into the "now" view of a run."""
+    """Fold metrics events into the "now" view of a run.
+
+    Thread contract: the live view ingests on a background tailer
+    thread (monitor_file) while the main thread renders, so every
+    mutable field is guarded by ``_lock`` (class-wide ``guarded-by-
+    default`` annotation, enforced by `sparknet lint` SPK201/202);
+    ``update``/``render`` take the lock, the ``_locked`` twins assume
+    it."""
+    # spk: guarded-by-default=_lock
 
     def __init__(self):
+        self._lock = threading.Lock()
         self.events = 0
         self.bad_lines = 0
         self.by_type = collections.Counter()
@@ -55,7 +65,15 @@ class MonitorState:
         self.quorum_lost = None
         self.done = None            # summary event, if the run finished
 
-    def update(self, ev):
+    def update(self, ev):               # spk: thread-entry
+        with self._lock:
+            self._update_locked(ev)
+
+    def note_bad_line(self):            # spk: thread-entry
+        with self._lock:
+            self.bad_lines += 1
+
+    def _update_locked(self, ev):       # spk: holds=_lock
         self.events += 1
         kind = ev.get("event", "?")
         self.by_type[kind] += 1
@@ -127,6 +145,10 @@ class MonitorState:
         return "[" + " ".join(fmt.format(v) for v in vals) + "]"
 
     def render(self, path=""):
+        with self._lock:
+            return self._render_locked(path)
+
+    def _render_locked(self, path):     # spk: holds=_lock
         L = []
         status = "FINISHED" if self.done else "live"
         L.append(f"sparknet monitor — {path} ({self.events} events, "
@@ -297,12 +319,12 @@ def monitor_file(path, interval=1.0, once=False, wait=False,
             try:
                 ev = json.loads(line)
             except ValueError:
-                state.bad_lines += 1
+                state.note_bad_line()
                 continue
             if isinstance(ev, dict):
                 state.update(ev)
             else:
-                state.bad_lines += 1
+                state.note_bad_line()
         return got
 
     ingest()
@@ -311,14 +333,39 @@ def monitor_file(path, interval=1.0, once=False, wait=False,
             raise MetricsFileError(f"metrics file is empty: {path}")
         write(state.render(path))
         return state
+    # live view: a background tailer thread ingests continuously (the
+    # _Tail cursor is confined to it between start and join), so a slow
+    # terminal write or a long --interval never backs the cursor up;
+    # MonitorState's lock makes the concurrent update/render safe (the
+    # discipline `sparknet lint`'s SPK201 checker enforces)
+    stop = threading.Event()
+    pump_err = []
+
+    def pump():
+        while not stop.wait(min(interval, 0.5)):
+            try:
+                ingest()
+            except Exception as e:      # surfaced on the render side
+                pump_err.append(e)
+                return
+
+    tailer = threading.Thread(target=pump, daemon=True,
+                              name="sparknet-monitor-tail")
+    tailer.start()
     try:
         while True:
             write(("\x1b[2J\x1b[H" if clear else "")
                   + state.render(path) + ("" if clear else "\n"))
+            if pump_err:
+                raise pump_err[0]
             if duration is not None and time.time() - t0 >= duration:
                 break
             time.sleep(interval)
-            ingest()
     except KeyboardInterrupt:
         pass
+    finally:
+        stop.set()
+        tailer.join(timeout=2.0)
+    if not pump_err:
+        ingest()                        # final drain (tailer has quit)
     return state

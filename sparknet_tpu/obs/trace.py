@@ -28,8 +28,8 @@ class Tracer:
         self.t0 = time.perf_counter()
         self._tls = threading.local()
         self._lock = threading.Lock()
-        self._buf = []
-        self.dropped = 0
+        self._buf = []              # spk: guarded-by=_lock
+        self.dropped = 0            # spk: guarded-by=_lock
         self.max_buffer = max_buffer
 
     def _stack(self):
@@ -83,7 +83,10 @@ class Tracer:
 
     def export_chrome(self, path):
         """Write buffered spans as a Chrome trace_event JSON file."""
-        return export_chrome(path, self.spans(), dropped=self.dropped)
+        with self._lock:
+            # one consistent snapshot: buffer and its drop count
+            spans, dropped = list(self._buf), self.dropped
+        return export_chrome(path, spans, dropped=dropped)
 
 
 def chrome_from_spans(spans, pid=None):

@@ -50,7 +50,12 @@ class Watchdog:
         self._exit = exit_fn or os._exit
         self.metrics = metrics
         self.poll = poll_seconds or min(10.0, self.stall_seconds / 4)
-        self._last = time.monotonic()
+        # the beat timestamp is the one field BOTH sides touch — the
+        # training thread writes it per step, the monitor thread reads
+        # and re-arms it (a race here mistimes stall detection; found
+        # by the SPK204 lock-discipline checker, sparknet lint)
+        self._lock = threading.Lock()
+        self._last = time.monotonic()   # spk: guarded-by=_lock
         self._stop = threading.Event()
         self._thread = None
         self.stalls = 0
@@ -59,7 +64,8 @@ class Watchdog:
     def start(self):
         if self._thread is not None and self._thread.is_alive():
             return self                     # idempotent: don't leak threads
-        self._last = time.monotonic()
+        with self._lock:
+            self._last = time.monotonic()
         self._stop.clear()
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="sparknet-watchdog")
@@ -68,7 +74,8 @@ class Watchdog:
 
     def beat(self, loss=None):
         """Call once per training step (host-side, costs nothing)."""
-        self._last = time.monotonic()
+        with self._lock:
+            self._last = time.monotonic()
         if loss is not None:
             v = float(loss)
             if not math.isfinite(v):
@@ -79,7 +86,8 @@ class Watchdog:
 
     def _run(self):
         while not self._stop.wait(self.poll):
-            dt = time.monotonic() - self._last
+            with self._lock:
+                dt = time.monotonic() - self._last
             if dt > self.stall_seconds:
                 self.stalls += 1
                 if self.metrics is not None:
@@ -92,7 +100,8 @@ class Watchdog:
                           file=sys.stderr)                # monitor thread
                 if self.kill_on_stall:
                     self._emergency_exit()
-                self._last = time.monotonic()   # re-arm
+                with self._lock:
+                    self._last = time.monotonic()   # re-arm
 
     def _emergency_exit(self):
         """Best-effort snapshot + metrics flush, then exit 42 (the code

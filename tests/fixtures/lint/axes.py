@@ -1,0 +1,72 @@
+"""SPK104 fixture corpus — collective axis-name mismatches. Parsed,
+never imported. Line numbers asserted in tests/test_lint.py."""
+
+import jax
+from jax.sharding import Mesh
+from jax.experimental.shard_map import shard_map
+
+DATA = "data"
+
+
+def make_mesh(axes, devices=None):
+    return Mesh(devices, tuple(axes))
+
+
+def masked_mean(tree, valid, axis):
+    # axis-forwarding helper: callers are checked at their call site
+    return jax.tree_util.tree_map(
+        lambda x: jax.lax.pmean(x, axis), tree)
+
+
+def wrong_literal(devices):
+    mesh = Mesh(devices, ("data",))
+
+    def f(x):
+        return jax.lax.pmean(x, "batch")             # SPK104 mismatch
+
+    return shard_map(f, mesh=mesh, in_specs=None, out_specs=None)
+
+
+def wrong_constant(devices):
+    mesh = Mesh(devices, ("model",))
+
+    def f(x):
+        return jax.lax.psum(x, DATA)                 # SPK104 via constant
+
+    return shard_map(f, mesh=mesh, in_specs=None, out_specs=None)
+
+
+def wrong_helper(devices):
+    mesh = make_mesh({"data": 8})
+
+    def f(tree, valid):
+        return masked_mean(tree, valid, "expert")    # SPK104 via helper
+
+    return shard_map(f, mesh=mesh, in_specs=None, out_specs=None)
+
+
+def right_axes(devices):
+    mesh = Mesh(devices, ("data", "seq"))
+
+    def f(x):
+        x = jax.lax.pmean(x, "seq")
+        i = jax.lax.axis_index("data")
+        return masked_mean(x, None, "data") + i
+
+    return shard_map(f, mesh=mesh, in_specs=None, out_specs=None)
+
+
+def unresolvable_is_silent(mesh, axis):
+    # neither the mesh nor the axis resolves statically: no guessing
+    def f(x):
+        return jax.lax.pmean(x, axis)
+
+    return shard_map(f, mesh=mesh, in_specs=None, out_specs=None)
+
+
+def wrong_suppressed(devices):
+    mesh = Mesh(devices, ("data",))
+
+    def f(x):
+        return jax.lax.pmean(x, "seq")  # spk: disable=SPK104
+    return shard_map(f, mesh=mesh, in_specs=None, out_specs=None)

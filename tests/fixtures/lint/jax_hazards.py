@@ -1,0 +1,66 @@
+"""SPK101/102/105 fixture corpus — positives, negatives, suppressed.
+
+Never imported at runtime; `sparknet lint` only parses it. Expected
+findings are asserted line-exactly in tests/test_lint.py, so EDITS
+HERE MUST UPDATE THAT TEST.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_MUTABLE_TABLE = {"scale": 2.0}
+
+
+def build_update(updater, lr_fn):
+    def step(params, state, history, batch, it, rng):
+        loss = float(jnp.sum(batch["x"]))            # SPK101 float
+        host = np.asarray(params["w"])               # SPK101 asarray
+        snap = jax.device_get(state)                 # SPK101 device_get
+        probe = loss if loss > 0 else 0.0            # noqa: F841
+        _ = host, snap
+        if it > 0:                                   # SPK102 if-on-traced
+            loss = loss + 1
+        for _ in range(it):                          # SPK102 for-on-traced
+            loss = loss + _MUTABLE_TABLE["scale"]    # SPK102 mutable global
+        params = updater(params, lr_fn(it))
+        return params, state, history, loss
+    return jax.jit(step)                             # SPK105 no donation
+
+
+def build_update_ok(updater, lr_fn):
+    tau = 4                                          # static closure
+
+    def step(params, state, history, batch, it, rng):
+        if tau > 1:                                  # static: no finding
+            batch = {k: v * 1.0 for k, v in batch.items()}
+        loss = jnp.sum(batch["x"])
+        params = updater(params, lr_fn(it))
+        return params, state, history, loss
+    return jax.jit(step, donate_argnums=(0, 1, 2))   # donated: no SPK105
+
+
+def build_eval(net):
+    # eval-style jit: params in, scores out — donation would be WRONG,
+    # and the rule must stay quiet here
+    def ev(params, state, batch):
+        blobs = net.apply(params, state, batch)
+        return {k: jnp.mean(v) for k, v in blobs.items()}
+    return jax.jit(ev)
+
+
+def build_update_suppressed(updater):
+    def step(params, state, batch, it):
+        dbg = float(jnp.sum(batch["x"]))  # spk: disable=SPK101
+        return updater(params, it), state, dbg
+    return jax.jit(step, donate_argnums=(0,))
+
+
+def static_arg_hazard(f):
+    jf = jax.jit(f, static_argnums=(1,))
+    return jf(jnp.ones(3), [1, 2])                   # SPK102 unhashable
+
+
+def host_driver(solver, loss):
+    # host-side float() is the DISPLAY discipline, not a finding
+    return float(loss)

@@ -1,0 +1,90 @@
+"""SPK201-204 fixture corpus — lock discipline. Parsed, never
+imported. Line numbers asserted in tests/test_lint.py."""
+
+import threading
+
+
+class Racy:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._beat = 0.0          # spk: guarded-by=_lock
+        self.count = 0
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def beat(self):
+        self._beat = 1.0                             # SPK202 main side
+        self.count += 1
+
+    def _run(self):
+        while True:
+            dt = self._beat                          # SPK201 thread side
+            self.count = 0                           # SPK204 unannotated
+            self._locked_ok(dt)
+
+    def _locked_ok(self, dt):
+        with self._lock:
+            self._beat = dt                          # held: no finding
+
+
+class Clean:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._x = 0               # spk: guarded-by=_lock
+        self._stop = threading.Event()
+
+    def start(self):
+        threading.Thread(target=self._run, daemon=True).start()
+
+    def _run(self):
+        while not self._stop.is_set():
+            with self._lock:
+                self._x += 1
+
+    def snapshot(self):
+        with self._lock:
+            return self._x
+
+
+class HoldsContract:
+    # spk: guarded-by-default=_lock
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.a = 0
+        self.b = 0
+
+    def update(self):             # spk: thread-entry
+        with self._lock:
+            self._bump_locked()
+
+    def _bump_locked(self):       # spk: holds=_lock
+        self.a += 1                                  # held by contract
+        self.b += 1
+
+    def broken(self):
+        self._bump_locked()                          # SPK202 holds-breach
+
+
+class StaleGuard:
+    def __init__(self):
+        self._y = 0               # spk: guarded-by=_gone  -> SPK203
+
+    def poke(self):
+        self._y = 1               # spk: disable=SPK202 (suppressed)
+
+
+class OptedOut:
+    def __init__(self):
+        self.hits = 0             # spk: unguarded (single-writer gauge)
+
+    def start(self):
+        threading.Thread(target=self._run).start()
+
+    def _run(self):
+        self.hits += 1
+
+    def reset(self):
+        self.hits = 0

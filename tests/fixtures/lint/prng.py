@@ -1,0 +1,60 @@
+"""SPK103 fixture corpus — PRNG key reuse. Parsed, never imported.
+Line numbers are asserted in tests/test_lint.py."""
+
+import jax
+
+
+def reuse_param_key(rng):
+    a = jax.random.normal(rng, (3,))
+    b = jax.random.uniform(rng, (3,))                # SPK103 reuse
+    return a + b
+
+
+def reuse_local_key():
+    k = jax.random.PRNGKey(0)
+    a = jax.random.normal(k, (3,))
+    b = jax.random.normal(k, (3,))                   # SPK103 reuse
+    return a + b
+
+
+def loop_reuse():
+    k = jax.random.PRNGKey(0)
+    out = []
+    for i in range(8):
+        out.append(jax.random.normal(k, (2,)))       # SPK103 loop reuse
+    return out
+
+
+def split_ok(rng):
+    k1, k2 = jax.random.split(rng)
+    a = jax.random.normal(k1, (3,))
+    b = jax.random.uniform(k2, (3,))
+    return a + b
+
+
+def fold_in_loop_ok(rng):
+    out = []
+    for i in range(8):
+        out.append(jax.random.normal(jax.random.fold_in(rng, i), (2,)))
+    return out
+
+
+def branch_ok(rng, gaussian):
+    # exclusive branches may each consume the key once
+    if gaussian:
+        return jax.random.normal(rng, (3,))
+    return jax.random.uniform(rng, (3,))
+
+
+def rebind_ok():
+    k = jax.random.PRNGKey(0)
+    a = jax.random.normal(k, (3,))
+    k = jax.random.PRNGKey(1)
+    b = jax.random.normal(k, (3,))
+    return a + b
+
+
+def reuse_suppressed(rng):
+    a = jax.random.normal(rng, (3,))
+    b = jax.random.normal(rng, (3,))  # spk: disable=SPK103
+    return a + b

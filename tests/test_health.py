@@ -416,6 +416,27 @@ class TestMonitorTail:
         st = monitor_file(str(p), once=True, out=got.append)
         assert st.events == 1 and "iter 1" in got[0]
 
+    def test_monitor_live_loop_ingests_on_tailer_thread(self, tmp_path):
+        # the live view runs a background tailer (MonitorState is
+        # lock-guarded — the discipline `sparknet lint` SPK201 checks);
+        # events appended mid-run must land in the final state
+        p = tmp_path / "m.jsonl"
+        p.write_text('{"event": "train", "iter": 1, "loss": 9.0}\n')
+        import threading
+
+        def append_late():
+            with open(p, "a") as f:
+                f.write('{"event": "train", "iter": 2, "loss": 8.0}\n')
+                f.write("garbage not json\n")
+        t = threading.Timer(0.15, append_late)
+        t.start()
+        got = []
+        st = monitor_file(str(p), interval=0.05, duration=0.6,
+                          out=got.append, clear=False)
+        t.join()
+        assert st.events == 2 and st.bad_lines == 1
+        assert st.iter == 2 and any("iter 2" in s for s in got)
+
 
 # -------------------------------------------- device-cache gauge (sat)
 

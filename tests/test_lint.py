@@ -1,0 +1,371 @@
+"""sparknet lint (sparknet_tpu.analysis): engine, rule corpus,
+baseline add/expire, CLI exit codes, and the repo self-lint gate.
+
+The fixture corpus under tests/fixtures/lint/ carries the expected
+finding per line; these tests assert (code, line) EXACTLY, so fixture
+edits must update the tables here.
+"""
+
+import argparse
+import json
+import os
+import textwrap
+
+import pytest
+
+from sparknet_tpu.analysis import lint_paths, Baseline
+from sparknet_tpu.analysis.cli import run_lint, DEFAULT_BASELINE
+from sparknet_tpu.cli import main as cli_main
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+FIXTURES = os.path.join(HERE, "fixtures", "lint")
+
+
+def fixture_findings(name, select=None):
+    return lint_paths([os.path.join(FIXTURES, name)], root=FIXTURES,
+                      select=select)
+
+
+def code_lines(findings):
+    return sorted((f.code, f.line) for f in findings)
+
+
+def mk_args(**kw):
+    base = dict(paths=[], strict=False, baseline=None,
+                write_baseline=False, justification=None, select=None,
+                root=None, json=False, verbose=False, list_rules=False)
+    base.update(kw)
+    return argparse.Namespace(**base)
+
+
+# ------------------------------------------------------------ rule corpus
+
+class TestJaxRuleCorpus:
+    def test_jax_hazards_positive_lines(self):
+        got = code_lines(fixture_findings("jax_hazards.py"))
+        assert got == sorted([
+            ("SPK101", 17),      # float() in traced step
+            ("SPK101", 18),      # np.asarray in traced step
+            ("SPK101", 19),      # jax.device_get in traced step
+            ("SPK102", 22),      # if on traced param
+            ("SPK102", 24),      # for over traced param
+            ("SPK102", 25),      # mutable module global captured
+            ("SPK105", 28),      # jit without donation
+            ("SPK102", 61),      # unhashable literal to static arg
+        ])
+
+    def test_prng_corpus(self):
+        got = code_lines(fixture_findings("prng.py"))
+        assert got == sorted([
+            ("SPK103", 9),       # param key reused
+            ("SPK103", 16),      # local key reused
+            ("SPK103", 24),      # outside-loop key consumed in loop
+        ])
+
+    def test_axes_corpus(self):
+        got = code_lines(fixture_findings("axes.py"))
+        assert got == sorted([
+            ("SPK104", 25),      # literal mismatch
+            ("SPK104", 34),      # module-constant mismatch
+            ("SPK104", 43),      # forwarded through masked_mean helper
+        ])
+
+    def test_clean_fixture_is_clean(self):
+        assert fixture_findings("clean.py") == []
+
+    def test_negatives_do_not_fire(self):
+        # the ok/suppressed halves of every fixture stay quiet: no
+        # finding may anchor inside any of these functions
+        quiet = {"build_update_ok", "build_eval",
+                 "build_update_suppressed", "host_driver", "split_ok",
+                 "fold_in_loop_ok", "branch_ok", "rebind_ok",
+                 "reuse_suppressed", "right_axes",
+                 "unresolvable_is_silent", "wrong_suppressed"}
+        for fname in ("jax_hazards.py", "prng.py", "axes.py"):
+            for f in fixture_findings(fname):
+                head = f.symbol.split(".")[0]
+                assert head not in quiet, f
+
+
+class TestThreadRuleCorpus:
+    def test_locks_corpus(self):
+        got = code_lines(fixture_findings("locks.py"))
+        assert got == sorted([
+            ("SPK202", 19),      # main-side unlocked write
+            ("SPK201", 24),      # thread-side unlocked read
+            ("SPK204", 25),      # unannotated both-sides write
+            ("SPK202", 68),      # holds= helper called without lock
+            ("SPK203", 73),      # guard names a lock that doesn't exist
+        ])
+
+    def test_clean_and_opted_out_classes_quiet(self):
+        for f in fixture_findings("locks.py"):
+            assert not f.symbol.startswith("Clean")
+            assert not f.symbol.startswith("OptedOut")
+            # HoldsContract's locked path is fine; only broken() flags
+            assert f.symbol != "HoldsContract.update"
+            assert f.symbol != "HoldsContract._bump_locked"
+
+
+# ------------------------------------------------------------ engine
+
+class TestEngine:
+    def test_inline_suppression(self, tmp_path):
+        p = tmp_path / "s.py"
+        p.write_text(textwrap.dedent("""\
+            import jax
+            def f(rng):
+                a = jax.random.normal(rng, (3,))
+                b = jax.random.normal(rng, (3,))  # spk: disable=SPK103
+                return a + b
+        """))
+        assert lint_paths([str(p)], root=str(tmp_path)) == []
+
+    def test_file_level_suppression(self, tmp_path):
+        p = tmp_path / "s.py"
+        p.write_text(textwrap.dedent("""\
+            # spk: disable-file=SPK103
+            import jax
+            def f(rng):
+                a = jax.random.normal(rng, (3,))
+                b = jax.random.normal(rng, (3,))
+                return a + b
+        """))
+        assert lint_paths([str(p)], root=str(tmp_path)) == []
+
+    def test_bare_disable_suppresses_everything(self, tmp_path):
+        p = tmp_path / "s.py"
+        p.write_text(textwrap.dedent("""\
+            import jax
+            def f(rng):
+                a = jax.random.normal(rng, (3,))
+                b = jax.random.normal(rng, (3,))  # spk: disable
+                return a + b
+        """))
+        assert lint_paths([str(p)], root=str(tmp_path)) == []
+
+    def test_syntax_error_becomes_spk001(self, tmp_path):
+        p = tmp_path / "broken.py"
+        p.write_text("def f(:\n")
+        fs = lint_paths([str(p)], root=str(tmp_path))
+        assert [f.code for f in fs] == ["SPK001"]
+        assert fs[0].severity == "error"
+
+    def test_select_filters_rules(self):
+        only = fixture_findings("jax_hazards.py", select={"SPK101"})
+        assert {f.code for f in only} == {"SPK101"}
+
+    def test_fingerprints_survive_line_shifts(self, tmp_path):
+        src = textwrap.dedent("""\
+            import jax
+            def f(rng):
+                a = jax.random.normal(rng, (3,))
+                b = jax.random.normal(rng, (3,))
+                return a + b
+        """)
+        p = tmp_path / "s.py"
+        p.write_text(src)
+        fp1 = [f.fingerprint()
+               for f in lint_paths([str(p)], root=str(tmp_path))]
+        p.write_text("# a comment pushing everything down\n\n" + src)
+        fp2 = [f.fingerprint()
+               for f in lint_paths([str(p)], root=str(tmp_path))]
+        assert fp1 == fp2 and len(fp1) == 1
+
+    def test_identical_findings_get_distinct_fingerprints(self, tmp_path):
+        p = tmp_path / "s.py"
+        p.write_text(textwrap.dedent("""\
+            import jax
+            def f(rng, n):
+                out = []
+                for i in range(n):
+                    out.append(jax.random.normal(rng, (2,)))
+                for i in range(n):
+                    out.append(jax.random.normal(rng, (2,)))
+                return out
+        """))
+        fs = lint_paths([str(p)], root=str(tmp_path))
+        fps = [f.fingerprint() for f in fs]
+        assert len(fps) == len(set(fps)) and len(fps) >= 2
+
+
+# ------------------------------------------------------------ baseline
+
+BAD_SRC = textwrap.dedent("""\
+    import jax
+    def f(rng):
+        a = jax.random.normal(rng, (3,))
+        b = jax.random.normal(rng, (3,))
+        return a + b
+""")
+
+CLEAN_SRC = textwrap.dedent("""\
+    import jax
+    def f(rng):
+        k1, k2 = jax.random.split(rng)
+        return jax.random.normal(k1, (3,)) + jax.random.normal(k2, (3,))
+""")
+
+
+class TestBaseline:
+    def _setup(self, tmp_path):
+        (tmp_path / "mod.py").write_text(BAD_SRC)
+        bl = str(tmp_path / DEFAULT_BASELINE)
+        args = dict(paths=[str(tmp_path / "mod.py")],
+                    root=str(tmp_path), baseline=bl)
+        return tmp_path, bl, args
+
+    def test_write_then_clean(self, tmp_path):
+        _, bl, args = self._setup(tmp_path)
+        out = []
+        rc = run_lint(mk_args(write_baseline=True,
+                              justification="known legacy reuse",
+                              **args), out=out.append)
+        assert rc == 0
+        data = json.load(open(bl))
+        assert len(data["entries"]) == 1
+        (entry,) = data["entries"].values()
+        assert entry["justification"] == "known legacy reuse"
+        # baselined finding no longer fails, even under --strict
+        assert run_lint(mk_args(strict=True, **args),
+                        out=lambda s: None) == 0
+
+    def test_new_violation_still_fails(self, tmp_path):
+        p, bl, args = self._setup(tmp_path)
+        run_lint(mk_args(write_baseline=True, justification="legacy",
+                         **args), out=lambda s: None)
+        (p / "mod.py").write_text(
+            BAD_SRC + "\n\ndef g(key):\n"
+            "    x = jax.random.normal(key, (2,))\n"
+            "    return x + jax.random.normal(key, (2,))\n")
+        assert run_lint(mk_args(strict=True, **args),
+                        out=lambda s: None) == 1
+        assert run_lint(mk_args(**args), out=lambda s: None) == 1
+
+    def test_stale_entries_reported_and_expired(self, tmp_path):
+        p, bl, args = self._setup(tmp_path)
+        run_lint(mk_args(write_baseline=True, justification="legacy",
+                         **args), out=lambda s: None)
+        (p / "mod.py").write_text(CLEAN_SRC)   # finding fixed -> stale
+        out = []
+        assert run_lint(mk_args(**args), out=out.append) == 0
+        assert any("stale baseline entry" in s for s in out)
+        # strict refuses a rotting baseline
+        assert run_lint(mk_args(strict=True, **args),
+                        out=lambda s: None) == 1
+        # --write-baseline expires it
+        run_lint(mk_args(write_baseline=True, **args),
+                 out=lambda s: None)
+        assert json.load(open(bl))["entries"] == {}
+        assert run_lint(mk_args(strict=True, **args),
+                        out=lambda s: None) == 0
+
+    def test_unjustified_entries_fail_strict(self, tmp_path):
+        _, bl, args = self._setup(tmp_path)
+        # no --justification: placeholder recorded
+        run_lint(mk_args(write_baseline=True, **args),
+                 out=lambda s: None)
+        out = []
+        assert run_lint(mk_args(strict=True, **args),
+                        out=out.append) == 1
+        assert any("unjustified baseline entry" in s for s in out)
+        assert run_lint(mk_args(**args), out=lambda s: None) == 0
+
+    def test_malformed_baseline_is_usage_error(self, tmp_path):
+        _, bl, args = self._setup(tmp_path)
+        with open(bl, "w") as f:
+            f.write("{nope")
+        assert run_lint(mk_args(**args), out=lambda s: None,
+                        err=lambda s: None) == 2
+
+
+# ------------------------------------------------------------ CLI
+
+class TestCLI:
+    def test_seeded_violation_exits_nonzero(self, tmp_path):
+        bad = tmp_path / "bad.py"
+        bad.write_text(BAD_SRC)
+        rc = cli_main(["lint", str(bad), "--root", str(tmp_path),
+                       "--strict",
+                       "--baseline", str(tmp_path / "b.json")])
+        assert rc == 1
+
+    def test_clean_file_exits_zero(self, tmp_path, capsys):
+        ok = tmp_path / "ok.py"
+        ok.write_text(CLEAN_SRC)
+        rc = cli_main(["lint", str(ok), "--root", str(tmp_path),
+                       "--strict",
+                       "--baseline", str(tmp_path / "b.json")])
+        assert rc == 0
+        assert "0 findings" in capsys.readouterr().out
+
+    def test_warnings_pass_without_strict_fail_with(self, tmp_path):
+        p = tmp_path / "w.py"
+        p.write_text(textwrap.dedent("""\
+            import jax
+            def build(updater):
+                def step(params, it):
+                    return updater(params, it)
+                def ret(params, it):
+                    params = step(params, it)
+                    return params, it
+                return jax.jit(ret)
+        """))
+        common = ["lint", str(p), "--root", str(tmp_path),
+                  "--baseline", str(tmp_path / "b.json")]
+        assert cli_main(common) == 0           # SPK105 is a warning
+        assert cli_main(common + ["--strict"]) == 1
+
+    def test_json_output(self, tmp_path, capsys):
+        bad = tmp_path / "bad.py"
+        bad.write_text(BAD_SRC)
+        cli_main(["lint", str(bad), "--root", str(tmp_path), "--json",
+                  "--baseline", str(tmp_path / "b.json")])
+        data = json.loads(capsys.readouterr().out)
+        assert data["findings"] and \
+            data["findings"][0]["code"] == "SPK103"
+        assert data["findings"][0]["path"] == "bad.py"
+
+    def test_list_rules(self, capsys):
+        assert cli_main(["lint", "--list-rules"]) == 0
+        out = capsys.readouterr().out
+        for code in ("SPK101", "SPK102", "SPK103", "SPK104", "SPK105",
+                     "SPK201", "SPK202", "SPK203", "SPK204"):
+            assert code in out
+
+    def test_missing_path_is_usage_error(self, tmp_path, capsys):
+        assert cli_main(["lint", str(tmp_path / "gone.py")]) == 2
+
+    def test_unknown_select_code_is_usage_error(self, tmp_path):
+        p = tmp_path / "ok.py"
+        p.write_text(CLEAN_SRC)
+        assert cli_main(["lint", str(p), "--select", "SPK999",
+                         "--baseline", str(tmp_path / "b.json")]) == 2
+
+
+# ------------------------------------------------------------ self-lint
+
+class TestSelfLint:
+    def test_repo_lints_clean_modulo_baseline(self):
+        """The acceptance gate CI runs (scripts/lint.sh): the package
+        source must produce zero non-baselined findings, zero stale
+        baseline entries, and every baseline entry must carry a real
+        justification."""
+        out = []
+        rc = run_lint(mk_args(
+            paths=[os.path.join(REPO, "sparknet_tpu")], root=REPO,
+            strict=True,
+            baseline=os.path.join(REPO, DEFAULT_BASELINE)),
+            out=out.append)
+        assert rc == 0, "\n".join(out)
+
+    def test_fixture_corpus_detects_every_rule_class(self):
+        """Meta-check: the corpus must keep at least one positive per
+        rule family, so a rule silently breaking shows up here."""
+        codes = set()
+        for fname in ("jax_hazards.py", "prng.py", "axes.py",
+                      "locks.py"):
+            codes |= {f.code for f in fixture_findings(fname)}
+        assert {"SPK101", "SPK102", "SPK103", "SPK104", "SPK105",
+                "SPK201", "SPK202", "SPK203", "SPK204"} <= codes
