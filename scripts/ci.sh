@@ -13,6 +13,12 @@ echo "compileall OK"
 bash scripts/lint.sh || exit 1
 echo "sparknet lint OK"
 
+# multi-host fault domains, end to end: a real 2-process run where one
+# host is SIGKILLed mid-run — the survivor must evict it on lease
+# expiry, finish, and exit 0 (the fast stage of scripts/smoke.sh)
+bash scripts/smoke.sh multihost || exit 1
+echo "multihost smoke OK"
+
 set -o pipefail
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu \

@@ -14,13 +14,70 @@
 #   (f) a chaos-killed worker is evicted, the run completes on the
 #       survivors, the eviction (and readmission) appear in `sparknet
 #       report`, and dropping below --quorum exits with code 4.
+# Multi-host fault domains (ISSUE 6):
+#   (g) a REAL 2-process run with leased heartbeats: chaos SIGKILLs one
+#       host mid-run, the survivor evicts it on lease expiry, completes
+#       every round, exits 0, and `sparknet report` shows the host
+#       eviction + fault-domain section.
+#
+# Usage: smoke.sh [all|multihost]  — `multihost` runs only stage (g)
+# (the fast CI wiring; scripts/ci.sh invokes it).
 set -euo pipefail
 cd "$(dirname "$0")/.."
 export JAX_PLATFORMS=cpu
 export PYTHONPATH="${PYTHONPATH:-}:$(pwd)"
 
+stage="${1:-all}"
+
 tmp=$(mktemp -d)
 trap 'rm -rf "$tmp"' EXIT
+
+# ------------------------------------------ multi-host fault domains ----
+# 2 real processes (jax.distributed, one fault domain each), hierarchical
+# local SGD with the heartbeat runtime; chaos SIGKILLs host 1 at the gate
+# of round 2. Host 0 must evict it (lease_expired), finish all 5 rounds,
+# and exit 0; the report must render the host eviction.
+run_multihost_stage() {
+    mh="$tmp/mh"
+    mkdir -p "$mh"
+    port=$(python -c "import socket; s=socket.socket(); \
+s.bind(('localhost',0)); print(s.getsockname()[1])")
+    pids=()
+    for i in 0 1; do
+        SPARKNET_COORDINATOR="localhost:$port" \
+        SPARKNET_NUM_PROCESSES=2 SPARKNET_PROCESS_ID=$i \
+        SPARKNET_CHAOS="kill_host=1,kill_host_round=2" \
+        XLA_FLAGS="--xla_force_host_platform_device_count=4" \
+        python -m sparknet_tpu cifar --workers 4 --hosts 2 --tau 2 \
+            --rounds 5 --test-every 100 --metrics "$mh/run$i.jsonl" \
+            --heartbeat-dir "$mh/rdv" --lease-s 1.5 \
+            --heartbeat-interval 0.2 \
+            --quorum 1 --evict-after 1 --readmit-after 0 \
+            > "$mh/out$i.txt" 2>&1 &
+        pids+=($!)
+    done
+    rc0=0; wait "${pids[0]}" || rc0=$?
+    rc1=0; wait "${pids[1]}" || rc1=$?
+    test "$rc0" -eq 0 || { echo "survivor host failed (rc=$rc0):"
+                           cat "$mh/out0.txt"; exit 1; }
+    test "$rc1" -ne 0 || { echo "chaos target was supposed to die"
+                           exit 1; }
+    grep -q "EVICTED host 1" "$mh/out0.txt"
+    grep -qE "round 4: loss = [0-9.]+" "$mh/out0.txt"
+    python -m sparknet_tpu report "$mh/run0.jsonl" | tee "$mh/rep.txt" \
+        > /dev/null
+    grep -q "multi-host fault domains" "$mh/rep.txt"
+    grep -q "evicted host 1" "$mh/rep.txt"
+    grep -q "lease_expired" "$mh/rep.txt"
+    echo "multihost stage OK: SIGKILLed host evicted on lease expiry," \
+         "survivor completed and exited 0"
+}
+
+if [ "$stage" = "multihost" ]; then
+    run_multihost_stage
+    echo "SMOKE OK (multihost)"
+    exit 0
+fi
 
 cat > "$tmp/net.prototxt" <<'EOF'
 name: "smoke_cifar_synth"
@@ -210,5 +267,7 @@ test "$rc" -eq 4 || { echo "expected exit 4 on quorum loss, got $rc"
                       cat "$tmp/quorum.out"; exit 1; }
 grep -q "QUORUM LOST" "$tmp/quorum.out"
 echo "elasticity stage OK: eviction survived, quorum loss exits 4"
+
+run_multihost_stage
 
 echo "SMOKE OK"

@@ -37,7 +37,7 @@ class CifarApp:
 
     def __init__(self, num_workers=None, data_dir=None, prototxt_dir=None,
                  strategy="local_sgd", tau=10, log_path=None, seed=None,
-                 metrics_path=None):
+                 metrics_path=None, hosts=0):
         self.t0 = time.time()
         self.logf = open(log_path, "w") if log_path else None
         self.metrics_path = metrics_path
@@ -48,10 +48,32 @@ class CifarApp:
         self.metrics = MetricsLogger(metrics_path) if metrics_path else None
         self.rng = np.random.RandomState(seed)
         self._train_f32 = None
-        from ..parallel import distributed_init
-        distributed_init()      # no-op single-process (DEPLOY.md)
-        mesh = make_mesh({"data": num_workers if num_workers else -1})
-        self.num_workers = mesh.shape["data"]
+        from ..parallel import multihost
+        multihost.init_runtime()    # no-op single-process (DEPLOY.md)
+        self.hosts = int(hosts or 0)
+        if self.hosts and strategy != "local_sgd":
+            raise ValueError("--hosts (hierarchical fault domains) needs "
+                             "strategy local_sgd")
+        if self.hosts:
+            import jax
+            if jax.process_count() > 1:
+                # one fault domain per process; auto_host_mesh picks the
+                # collective or relay transport for this backend
+                mesh = multihost.auto_host_mesh(
+                    per_host=num_workers or None)
+            else:
+                total = num_workers if num_workers else \
+                    len(jax.devices())
+                if total % self.hosts:
+                    raise ValueError(f"{total} workers not divisible by "
+                                     f"{self.hosts} hosts")
+                mesh = multihost.host_mesh(hosts=self.hosts,
+                                           per_host=total // self.hosts)
+            self.num_workers = int(np.prod(
+                [mesh.shape[a] for a in mesh.axis_names]))
+        else:
+            mesh = make_mesh({"data": num_workers if num_workers else -1})
+            self.num_workers = mesh.shape["data"]
         self.strategy = strategy
 
         # data: real CIFAR binaries if present, synthetic stand-in otherwise
@@ -84,7 +106,9 @@ class CifarApp:
         if strategy == "local_sgd":
             self.solver = LocalSGDSolver(solver_param, mesh=mesh, tau=tau,
                                          net_param=net, log_fn=self.log,
-                                         metrics=self.metrics)
+                                         metrics=self.metrics,
+                                         host_axis="host"
+                                         if self.hosts else None)
         else:
             self.solver = DataParallelSolver(solver_param, mesh=mesh,
                                              net_param=net, log_fn=self.log,
@@ -113,26 +137,53 @@ class CifarApp:
         idx = (start + np.arange(n_images)) % n
         return imgs[idx], labs[idx]
 
+    def _slot_owners(self):
+        """Per-SLOT re-spread owners when elastic evictions are in
+        force, or None when every slot draws fresh data. Worker-unit
+        membership maps 1:1 to mesh slots; host-unit membership (the
+        hierarchical mesh) expands each live host's rank over its
+        device row. Relay-mode multi-process runs (policy world spans
+        processes, mesh is local) never re-spread locally — the dead
+        hosts are remote."""
+        elastic = getattr(self.solver, "elastic", None)
+        if elastic is None or elastic.live_count() >= elastic.n:
+            return None
+        shape = self.solver.mesh.shape
+        per_host = shape["data"]
+        n_slots = per_host * shape.get("host", 1)
+        if elastic.unit == "host":
+            if elastic.n != shape.get("host", 1):
+                return None             # relay mode: remote membership
+            owners_host = elastic.shard_owners()
+            return [owners_host[s // per_host] * per_host + s % per_host
+                    for s in range(n_slots)]
+        return elastic.shard_owners()
+
     def _tau_batches(self, tau):
         """(tau, workers*batch, ...) arrays: each worker's contiguous window
         of its partition (the MinibatchSampler random-window behavior).
 
-        With elastic membership armed and workers evicted, the fresh
-        data is drawn for the LIVE workers only — the re-partitioning of
-        the dead workers' stream across the survivors — and dead mesh
-        slots receive a survivor's copy, which the round's validity mask
-        discards on device (resilience/elastic.py). Membership changes
-        reach here with the prefetch queue's 1-2 round lag, exactly like
-        batches already in flight when a real worker dies."""
-        n_slots = self.solver.mesh.shape["data"]
-        elastic = getattr(self.solver, "elastic", None)
-        if elastic is not None and elastic.live_count() < n_slots:
+        With elastic membership armed and workers (or whole hosts, on
+        the hierarchical mesh) evicted, the fresh data is drawn for the
+        LIVE slots only — the re-partitioning of the dead workers'
+        stream across the survivors — and dead mesh slots receive a
+        survivor's copy, which the round's validity mask discards on
+        device (resilience/elastic.py). Membership changes reach here
+        with the prefetch queue's 1-2 round lag, exactly like batches
+        already in flight when a real worker dies."""
+        shape = self.solver.mesh.shape
+        n_slots = shape["data"] * shape.get("host", 1)
+        owners = self._slot_owners()
+        if owners is not None:
             from ..resilience.elastic import expand_to_slots
-            k = elastic.live_count()
+            k = len(set(owners))        # live slots actually drawn for
             imgs, labs = self._train_arrays(tau * TRAIN_BATCH * k)
             si = list(imgs.reshape(k, tau, TRAIN_BATCH, 3, 32, 32))
             sl = list(labs.reshape(k, tau, TRAIN_BATCH))
-            owners = elastic.shard_owners()
+            # owners name live slots by their mesh index; re-rank them
+            # into the drawn (live-ordered) shard list
+            rank = {s: i for i, s in enumerate(sorted(set(owners)))}
+            owners = [rank[o] for o in owners]
             imgs = expand_to_slots(si, owners)
             labs = expand_to_slots(sl, owners)
         else:

@@ -198,7 +198,9 @@ def cmd_train(args):
                             lr_decay=args.recover_lr_decay,
                             explode_factor=args.recover_explode_factor)
     _apply_health_flags(solver, args)
-    _apply_elastic_flags(solver, args)
+    _apply_heartbeat_flags(solver, args)     # before elastic: the relay
+    _apply_elastic_flags(solver, args)       # world sizes to processes
+    hb = solver.heartbeat                    # close() drops the reference
     if args.weights:
         solver.load_weights(args.weights)
     if args.snapshot:
@@ -272,10 +274,13 @@ def cmd_train(args):
                         # too few live workers for a trustworthy
                         # consensus — distinct exit for the supervisor
                         # (DEPLOY.md runbook). The masked consensus up
-                        # to here is healthy: keep it for the relaunch.
+                        # to here is healthy: keep it for the relaunch,
+                        # and in a multi-host world barrier every
+                        # survivor on the same manifest before exiting.
                         print(f"QUORUM LOST: {e}")
                         if prefix:
                             solver.snapshot(prefix=prefix)
+                            solver.coordinated_restart(prefix)
                         rc = EXIT_QUORUM_LOST
                         break
                 blocks_done += 1
@@ -316,6 +321,10 @@ def cmd_train(args):
     print(f"Optimization done, iter={solver.iter}")
     if metrics:
         metrics.close()
+    # a run that SURVIVED a peer-host death must report ITS exit code,
+    # not die in the unreachable jax.distributed shutdown barrier
+    from .parallel.multihost import exit_if_peers_died
+    exit_if_peers_died(rc, hb)
     return rc
 
 
@@ -486,20 +495,27 @@ def cmd_cifar(args):
     app = CifarApp(num_workers=args.workers, data_dir=args.data,
                    prototxt_dir=args.prototxt_dir, strategy=args.strategy,
                    tau=args.tau, log_path=args.log,
-                   metrics_path=args.metrics)
+                   metrics_path=args.metrics, hosts=args.hosts)
     from .resilience.chaos import active_chaos
     ch = active_chaos()
     if ch is not None and ch.metrics is None and app.metrics is not None:
         ch.metrics = app.metrics     # chaos events land in the run's JSONL
     _apply_health_flags(app.solver, args)
+    _apply_heartbeat_flags(app.solver, args)
     _apply_elastic_flags(app.solver, args)
+    hb = getattr(app.solver, "heartbeat", None)   # close() drops the ref
     from .resilience.elastic import QuorumLost, EXIT_QUORUM_LOST
+    from .parallel.multihost import exit_if_peers_died
+    rc = 0
     try:
         app.run(num_rounds=args.rounds, test_every=args.test_every)
     except QuorumLost as e:
         print(f"QUORUM LOST: {e}")
-        return EXIT_QUORUM_LOST
-    return 0
+        rc = EXIT_QUORUM_LOST
+    # a run that SURVIVED a peer-host death must report ITS exit code,
+    # not die in the unreachable jax.distributed shutdown barrier
+    exit_if_peers_died(rc, hb)
+    return rc
 
 
 def cmd_lm(args):
@@ -725,6 +741,34 @@ def cmd_monitor(args):
     return 0 if state.events else 2
 
 
+def _add_heartbeat_flags(p):
+    """--heartbeat-dir / --lease-s / --heartbeat-interval: host-level
+    fault domains (resilience/heartbeat.py). Passing --heartbeat-dir
+    arms leased liveness + the pre-round rendezvous gate; in a
+    multi-process world it also selects the snapshot writer and the
+    coordinated-restart barrier."""
+    p.add_argument("--heartbeat-dir", metavar="DIR",
+                   help="shared rendezvous directory (every host must "
+                        "reach it): arms leased heartbeats, host-level "
+                        "eviction on lease expiry, the no-hang round "
+                        "gate, and coordinated restart on quorum loss")
+    p.add_argument("--lease-s", type=float, default=3.0,
+                   help="heartbeat lease: a host silent this long is "
+                        "dead (evicted at the next round gate)")
+    p.add_argument("--heartbeat-interval", type=float, default=0.5,
+                   help="seconds between heartbeat re-leases (must be "
+                        "well under --lease-s)")
+
+
+def _apply_heartbeat_flags(solver, args):
+    if not getattr(args, "heartbeat_dir", None) or \
+            not hasattr(solver, "arm_heartbeat"):
+        return
+    solver.arm_heartbeat(args.heartbeat_dir,
+                         interval_s=args.heartbeat_interval,
+                         lease_s=args.lease_s)
+
+
 def _add_elastic_flags(p):
     """--quorum / --evict-after / --readmit-after: the elastic
     membership layer (resilience/elastic.py). Passing any of them arms
@@ -901,6 +945,7 @@ def main(argv=None):
                         "sparknet_tpu/resilience/chaos.py)")
     _add_health_flags(t)
     _add_elastic_flags(t)
+    _add_heartbeat_flags(t)
     t.set_defaults(fn=cmd_train)
 
     te = sub.add_parser("test", help="score a model")
@@ -997,6 +1042,14 @@ def main(argv=None):
     c.add_argument("--prototxt-dir", help="dir with stock cifar10 prototxts")
     c.add_argument("--strategy", choices=("local_sgd", "dp"),
                    default="local_sgd")
+    c.add_argument("--hosts", type=int, default=0,
+                   help="N>0: hierarchical local SGD over N host fault "
+                        "domains (two-tier: per-step grad pmean inside "
+                        "a host, tau-interval masked averaging across "
+                        "hosts; membership/eviction at host "
+                        "granularity). Single-process: N virtual "
+                        "domains partition the local devices; "
+                        "multi-process: one domain per process")
     c.add_argument("--tau", type=int, default=10)
     c.add_argument("--rounds", type=int, default=20)
     c.add_argument("--test-every", type=int, default=10,
@@ -1011,6 +1064,7 @@ def main(argv=None):
                         "mid-run; also via SPARKNET_CHAOS)")
     _add_health_flags(c)
     _add_elastic_flags(c)
+    _add_heartbeat_flags(c)
     c.set_defaults(fn=cmd_cifar)
 
     lm = sub.add_parser("lm", help="transformer-LM driver (synthetic "

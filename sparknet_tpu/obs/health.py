@@ -30,6 +30,13 @@ can alert on:
                     resilience/elastic.py already excluded it — this
                     alarm is the paper trail, and the eviction streak
                     the ElasticPolicy acts on)
+  host_down         a peer HOST's heartbeat lease expired (resilience/
+                    heartbeat.py) — the fault-domain-granularity crash
+                    signal; the eviction itself is the ElasticPolicy's,
+                    this alarm is the sensor-side paper trail
+  host_lease        a live host's lease age crossed half the lease —
+                    it is still in the membership but its heartbeats
+                    are lagging (pre-failure warning)
 
 With an ElasticPolicy armed, the detectors receive the alive mask and
 skip evicted workers — a dead slot's (masked, meaningless) latency or
@@ -220,6 +227,35 @@ class HealthMonitor:
                         grew=f"x{w[-1] / max(w[0], 1e-20):.2f} over "
                              f"{self.trend_rounds} rounds",
                         suggest_tau=half)
+
+    def observe_hosts(self, round_idx, alive=None, lease_age_s=None,
+                      lease_s=None, wait_s=None):
+        """Feed one round gate's host-liveness view (resilience/
+        heartbeat.py): ``alive`` the per-host mask, ``lease_age_s`` the
+        per-host lease ages, ``lease_s`` the lease the ages are judged
+        against, ``wait_s`` the gate's wait. Fault-domain-granularity
+        twins of the worker detectors."""
+        self._obs += 1
+        try:
+            if alive is not None:
+                a = np.asarray(alive).ravel()
+                for h in range(a.size):
+                    if not a[h]:
+                        self._alarm("host_down", severity="critical",
+                                    round=round_idx, host=int(h))
+            if lease_age_s is not None and lease_s:
+                ages = np.asarray(lease_age_s, np.float64).ravel()
+                for h in range(ages.size):
+                    if alive is not None and h < np.asarray(alive).size \
+                            and not np.asarray(alive).ravel()[h]:
+                        continue        # dead: host_down already fired
+                    if float(lease_s) > ages[h] > 0.5 * float(lease_s):
+                        self._alarm("host_lease", round=round_idx,
+                                    host=int(h),
+                                    lease_age_s=round(float(ages[h]), 3),
+                                    lease_s=float(lease_s))
+        except Exception as e:          # detectors must never kill a run
+            self.log(f"health: host detector error: {e!r}")
 
     # -- public API --------------------------------------------------------
     def observe_round(self, it, round_idx=None, worker_losses=None,

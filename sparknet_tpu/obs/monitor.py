@@ -63,6 +63,12 @@ class MonitorState:
         self.last_eviction = None
         self.readmissions = 0
         self.quorum_lost = None
+        # host fault domains (resilience/heartbeat.py)
+        self.host_alive = {}        # host -> bool (last transition)
+        self.host_lease_age = None  # last per-host lease-age vector
+        self.host_gate = None       # last host_round event
+        self.host_evictions = collections.Counter()
+        self.coordinated_restart = None
         self.done = None            # summary event, if the run finished
 
     def update(self, ev):               # spk: thread-entry
@@ -134,8 +140,20 @@ class MonitorState:
         elif kind == "membership":
             if ev.get("kind") == "quorum_lost":
                 self.quorum_lost = ev
+            if ev.get("kind") == "coordinated_restart":
+                self.coordinated_restart = ev
             if _num(ev.get("live")):
                 self.live = ev["live"]
+        elif kind == "host_alive":
+            if ev.get("host") is not None:
+                self.host_alive[int(ev["host"])] = bool(ev.get("alive"))
+        elif kind == "host_round":
+            self.host_gate = ev
+            if isinstance(ev.get("lease_age_s"), list):
+                self.host_lease_age = ev["lease_age_s"]
+        elif kind == "host_evicted":
+            if ev.get("host") is not None:
+                self.host_evictions[int(ev["host"])] += 1
         elif kind == "summary":
             self.done = ev
 
@@ -211,6 +229,27 @@ class MonitorState:
                 q = self.quorum_lost
                 L.append(f"    QUORUM LOST: {q.get('live')} live < "
                          f"quorum {q.get('quorum')}")
+        if self.host_alive or self.host_gate or self.host_evictions:
+            bits = []
+            if self.host_alive:
+                down = sorted(h for h, a in self.host_alive.items() if not a)
+                up = sorted(h for h, a in self.host_alive.items() if a)
+                bits.append(f"up {up}" + (f" DOWN {down}" if down else ""))
+            if self.host_evictions:
+                bits.append("evicted " + ", ".join(
+                    f"h{h}:{c}" for h, c in self.host_evictions.most_common()))
+            if self.host_gate and _num(self.host_gate.get("wait_s")):
+                bits.append(f"gate wait {self.host_gate['wait_s']:.3f}s "
+                            f"@r{self.host_gate.get('round')}")
+            L.append("  hosts: " + "  ".join(bits))
+            if self.host_lease_age:
+                L.append("    lease ages: " + " ".join(
+                    f"{a:.2f}s" for a in self.host_lease_age))
+            if self.coordinated_restart is not None:
+                cr = self.coordinated_restart
+                L.append("    coordinated restart "
+                         + ("AGREED" if cr.get("agreed") else "DISAGREED")
+                         + f" across hosts {cr.get('hosts')}")
         if self.straggler_counts:
             worst = self.straggler_counts.most_common(1)[0]
             L.append(f"  stragglers: worker {worst[0]} flagged "

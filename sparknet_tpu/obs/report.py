@@ -185,7 +185,8 @@ def aggregate(events):
                     e.get("worker") for e in ev).items()}
             el["eviction_records"] = [
                 {"worker": e.get("worker"), "round": e.get("round"),
-                 "reason": e.get("reason")} for e in ev][:20]
+                 "reason": e.get("reason"),
+                 "unit": e.get("unit", "worker")} for e in ev][:20]
         lives = [e["live"] for e in (ev + rd + mem)
                  if _num(e.get("live"))]
         if lives:
@@ -200,6 +201,47 @@ def aggregate(events):
             el["mesh_shrunk"] = {"from": ms.get("from_world"),
                                  "to": ms.get("to_world")}
         rep["elasticity"] = el
+    # multi-host fault domains (resilience/heartbeat.py): per-host
+    # liveness transitions, lease ages, and the cross-host round gate
+    ha = [e for e in events if e.get("event") == "host_alive"]
+    hr = [e for e in events if e.get("event") == "host_round"]
+    he = [e for e in events if e.get("event") == "host_evicted"]
+    cr = [e for e in mem if e.get("kind") == "coordinated_restart"]
+    if ha or hr or he or cr:
+        mh = {}
+        if ha:
+            last = {}
+            for e in ha:
+                if e.get("host") is not None:
+                    last[int(e["host"])] = bool(e.get("alive"))
+            mh["liveness_transitions"] = len(ha)
+            mh["hosts_seen"] = sorted(last)
+            mh["hosts_down"] = sorted(h for h, a in last.items() if not a)
+            ages = [e["lease_age_s"] for e in ha
+                    if _num(e.get("lease_age_s"))]
+            if ages:
+                mh["max_lease_age_s"] = round(max(ages), 3)
+        if hr:
+            waits = [e["wait_s"] for e in hr if _num(e.get("wait_s"))]
+            g = {"rounds_gated": len(hr)}
+            g.update({f"wait_s_{k}": round(v, 4)
+                      for k, v in percentiles(waits).items()})
+            lastages = hr[-1].get("lease_age_s")
+            if isinstance(lastages, list):
+                g["last_lease_age_s"] = lastages
+            mh["round_gate"] = g
+        if he:
+            mh["host_evictions"] = [
+                {"host": e.get("host"), "round": e.get("round"),
+                 "reason": e.get("reason")} for e in he][:20]
+        if cr:
+            last = cr[-1]
+            mh["coordinated_restart"] = {
+                "agreed": last.get("agreed"),
+                "sha": (str(last.get("sha"))[:12] + "…")
+                if last.get("sha") else None,
+                "hosts": last.get("hosts")}
+        rep["multihost"] = mh
     cp = [e for e in events if e.get("event") == "checkpoint"]
     if cp:
         writes = [e for e in cp if e.get("kind") != "resume"]
@@ -437,7 +479,8 @@ def render(rep):
                 line += f", live dipped to {el['min_live']}"
             L.append(line)
             for r in el.get("eviction_records", [])[:10]:
-                L.append(f"    evicted worker {r.get('worker')} at round "
+                L.append(f"    evicted {r.get('unit', 'worker')} "
+                         f"{r.get('worker')} at round "
                          f"{r.get('round')}: {r.get('reason')}")
             if el.get("mesh_shrunk"):
                 L.append(f"    mesh shrunk {el['mesh_shrunk'].get('from')}"
@@ -447,6 +490,36 @@ def render(rep):
                 L.append(f"    QUORUM LOST at round {q.get('round')}: "
                          f"{q.get('live')} live < quorum "
                          f"{q.get('quorum')} (exit 4)")
+    mh = rep.get("multihost")
+    if mh:
+        hdr("multi-host fault domains")
+        if mh.get("hosts_seen") is not None:
+            line = f"  hosts observed: {mh['hosts_seen']}"
+            if mh.get("hosts_down"):
+                line += f", DOWN: {mh['hosts_down']}"
+            L.append(line)
+        if _num(mh.get("max_lease_age_s")):
+            L.append(f"  max lease age seen: {mh['max_lease_age_s']} s")
+        g = mh.get("round_gate")
+        if g:
+            ps = {q: g.get(f"wait_s_{q}") for q in ("p50", "p95", "p99")}
+            line = f"  round gate: {g.get('rounds_gated')} rounds"
+            if any(_num(v) for v in ps.values()):
+                line += ", wait " + "  ".join(
+                    f"{q}={ps[q]:.3f}s" for q in ("p50", "p95", "p99")
+                    if _num(ps[q]))
+            L.append(line)
+            if g.get("last_lease_age_s"):
+                L.append(f"    last lease ages: {g['last_lease_age_s']}")
+        for r in mh.get("host_evictions", [])[:10]:
+            L.append(f"  evicted host {r.get('host')} at round "
+                     f"{r.get('round')}: {r.get('reason')}")
+        cr = mh.get("coordinated_restart")
+        if cr:
+            L.append(f"  coordinated restart: "
+                     f"{'AGREED' if cr.get('agreed') else 'DISAGREED'} "
+                     f"on manifest {cr.get('sha')} across hosts "
+                     f"{cr.get('hosts')}")
     if any(rep.get(k) for k in ("divergence", "health", "memstats")):
         hdr("training health")
         d = rep.get("divergence")

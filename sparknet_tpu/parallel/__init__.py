@@ -24,8 +24,12 @@ import importlib
 
 __all__ = [
     "make_mesh", "mesh_axis_size", "distributed_init", "local_batch_slice",
+    "make_host_device_mesh", "is_local_mesh",
     "axis_context", "current_axes", "world_context", "current_world",
-    "context",
+    "publish_host_topology", "current_host",
+    "context", "multihost",
+    "init_runtime", "host_mesh", "auto_host_mesh", "survivor_mesh",
+    "needs_host_relay", "local_batch_rows", "my_host_rows",
     "DataParallelSolver", "LocalSGDSolver", "shard_batch",
     "GSPMDSolver", "default_param_rule", "SeqParallelSolver",
     "ExpertParallelSolver",
@@ -39,8 +43,14 @@ __all__ = [
 _EXPORTS = {
     "make_mesh": "mesh", "mesh_axis_size": "mesh",
     "distributed_init": "mesh", "local_batch_slice": "mesh",
+    "make_host_device_mesh": "mesh", "is_local_mesh": "mesh",
     "axis_context": "context", "current_axes": "context",
     "world_context": "context", "current_world": "context",
+    "publish_host_topology": "context", "current_host": "context",
+    "init_runtime": "multihost", "host_mesh": "multihost",
+    "auto_host_mesh": "multihost", "survivor_mesh": "multihost",
+    "needs_host_relay": "multihost", "local_batch_rows": "multihost",
+    "my_host_rows": "multihost",
     "DataParallelSolver": "data_parallel", "LocalSGDSolver": "data_parallel",
     "shard_batch": "data_parallel",
     "GSPMDSolver": "gspmd", "default_param_rule": "gspmd",
@@ -58,6 +68,6 @@ def __getattr__(name):
     if name in _EXPORTS:
         mod = importlib.import_module(f".{_EXPORTS[name]}", __name__)
         return getattr(mod, name)
-    if name in ("mesh", "context", "ring", "data_parallel"):
+    if name in ("mesh", "context", "ring", "data_parallel", "multihost"):
         return importlib.import_module(f".{name}", __name__)
     raise AttributeError(name)

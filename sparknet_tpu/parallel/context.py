@@ -55,3 +55,31 @@ def world_context(**info):
         yield _state.world
     finally:
         _state.world = prev
+
+
+# -- host topology (multi-host runtime) -------------------------------------
+# Unlike the trace-time axis/world contexts above, the host topology is a
+# process-wide constant: one process == one fault domain, fixed at
+# jax.distributed bring-up. parallel/multihost.py publishes it once;
+# everything host-side (heartbeats, coordinated restart, per-host data
+# slicing) reads it from here instead of re-deriving it from jax.
+_host_topology = None
+
+
+def publish_host_topology(info):
+    """Record this process's host topology (parallel/multihost.py calls
+    this after jax.distributed bring-up). ``info``: a mapping with at
+    least process_id / num_processes / local_device_count /
+    global_device_count."""
+    global _host_topology
+    _host_topology = dict(info)
+    return _host_topology
+
+
+def current_host():
+    """The published host topology dict, or a single-host default when
+    the multihost runtime never initialized (the common dev path)."""
+    if _host_topology is not None:
+        return dict(_host_topology)
+    return {"process_id": 0, "num_processes": 1,
+            "local_device_count": None, "global_device_count": None}

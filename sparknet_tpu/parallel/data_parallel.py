@@ -55,8 +55,19 @@ def shard_batch(batch, mesh, axis=DATA_AXIS, batch_dim=0, seq_axis=None,
     Single-process, already-on-device jax arrays are resharded without a
     host round trip; the multihost assembly paths need host-resident data
     and will fetch a device-resident input first.
+
+    ``axis`` may be a tuple of mesh axis names — the batch dim shards
+    over their product (the (host, data) layout of the hierarchical
+    runtime). A mesh made purely of THIS process's devices (a survivor
+    that shrank away its dead peers — mesh.is_local_mesh) always takes
+    the single-process device_put path: the global-assembly calls would
+    wait on processes that no longer exist.
     """
     multihost = jax.process_count() > 1
+    if multihost:
+        from .mesh import is_local_mesh
+        if is_local_mesh(mesh):
+            multihost = False
     out = {}
     for k, v in batch.items():
         if not isinstance(v, jax.Array):
@@ -391,16 +402,43 @@ class LocalSGDSolver(Solver):
     cifar10_full steps), which would poison the virtual-mesh experiments —
     and 1 on TPU, where the rolled loop compiles fast and runs at full
     speed.
+
+    host_axis: arms the HIERARCHICAL two-tier mode over a 2-D
+    (host_axis, axis) mesh (parallel.multihost.host_mesh): the local-SGD
+    "worker" becomes a whole host — its devices run per-step gradient
+    pmean over ``axis`` (synchronous DP inside the fault domain, over
+    ICI), hosts diverge for tau steps, and the round's collect & average
+    is the masked consensus over ``host_axis`` (over DCN) with a
+    PER-HOST alive mask. Membership — eviction, readmission, quorum —
+    operates at host granularity, matching the real production failure
+    unit (preemption/OOM kill whole processes, not single chips). With
+    one device per host the inner tier is skipped at trace time, so the
+    round is bit-for-bit the single-tier SparkNet round it generalizes.
     """
 
     def __init__(self, solver_param, mesh=None, axis=DATA_AXIS, tau=10,
-                 average_history=False, unroll=None, **kw):
-        from .mesh import make_mesh
-        self.mesh = mesh if mesh is not None else make_mesh({axis: -1})
+                 average_history=False, unroll=None, host_axis=None, **kw):
+        from .mesh import make_mesh, make_host_device_mesh
+        self.host_axis = host_axis
+        if mesh is None:
+            mesh = make_host_device_mesh(device_axis=axis) \
+                if host_axis is not None else make_mesh({axis: -1})
+        self.mesh = mesh
         self.axis = axis
+        if host_axis is not None and host_axis not in self.mesh.shape:
+            raise ValueError(f"host_axis {host_axis!r} not in mesh axes "
+                             f"{tuple(self.mesh.shape)}")
+        # membership granularity: per-host in hierarchical mode (the
+        # alive mask indexes fault domains), per-device-worker otherwise
+        self.elastic_axis = host_axis if host_axis is not None else axis
+        self.elastic_unit = "host" if host_axis is not None else "worker"
         self.tau = int(tau)
         self.unroll = unroll
         self.average_history = bool(average_history)
+        # cross-host transport for the tau-consensus: None = the
+        # compiled masked collective; a heartbeat.FileConsensus when
+        # arm_heartbeat decided the backend needs the relay
+        self._relay = None
         super().__init__(solver_param, **kw)
         self._jit_round = None
         self._round_idx = 0
@@ -408,7 +446,16 @@ class LocalSGDSolver(Solver):
     def _build_round(self, batch_example):
         net, updater, lr_fn = self.net, self.updater, self.lr_fn
         axis, tau = self.axis, self.tau
-        n_workers = self.mesh.shape[axis]
+        # two-tier wiring: the tau-interval consensus (and the alive
+        # mask) runs over sync_axis; intra > 1 arms the per-step
+        # gradient pmean over ``axis`` inside each fault domain. Both
+        # collapse at trace time in the degenerate configurations, so
+        # hosts=1 or one-device-per-host is the single-tier program
+        # bit-for-bit (the PR 4 masked-pmean guarantee style).
+        host_axis = self.host_axis
+        sync_axis = host_axis if host_axis is not None else axis
+        n_workers = self.mesh.shape[sync_axis]
+        intra = self.mesh.shape[axis] if host_axis is not None else 1
         unroll = self.unroll
         if unroll is None:
             # True = fully unroll regardless of tau (works on every jax
@@ -438,14 +485,33 @@ class LocalSGDSolver(Solver):
                 return loss, new_state
             (loss, new_state), grads = jax.value_and_grad(
                 lf, has_aux=True)(params)
+            if intra > 1:
+                # tier 1, per STEP: devices inside one fault domain are
+                # a synchronous DP group (grads pmean'd over ICI), so
+                # params/history stay replicated within the host and the
+                # host is ONE logical local-SGD worker
+                grads = jax.tree_util.tree_map(
+                    lambda g: jax.lax.pmean(g, axis), grads)
             params, history = updater(params, grads, history, lr_fn(it), it)
             return params, new_state, history, loss
 
+        def intra_mean(x):
+            """Fold a per-device value to its host's mean — a trace-time
+            no-op outside hierarchical mode (bit-for-bit single-tier)."""
+            if intra <= 1:
+                return x
+            return jax.tree_util.tree_map(
+                lambda v: jax.lax.pmean(v, axis), x)
+
         def round_fn(params, state, history, batches, it0, rng, alive):
             params_in = params          # the round's broadcast weights
-            w = jax.lax.axis_index(axis)
+            w = jax.lax.axis_index(sync_axis)
             my_alive = alive[w]
             rng = jax.random.fold_in(rng, w)
+            if intra > 1:
+                # distinct dropout/augmentation streams per device inside
+                # the host (their grads average, like any DP group)
+                rng = jax.random.fold_in(rng, jax.lax.axis_index(axis) + 1)
 
             def body(carry, inp):
                 params, state, history = carry
@@ -462,46 +528,66 @@ class LocalSGDSolver(Solver):
             # validity: the host-declared alive bit AND (with elasticity
             # armed) the on-device finite check over this worker's
             # replica — a replica that went NaN mid-round can never
-            # poison the consensus, even before the host evicts it
+            # poison the consensus, even before the host evicts it. In
+            # hierarchical mode the fault domain is valid only when
+            # EVERY one of its devices is (pmin over the intra axis).
             if elastic_on:
                 finite = jnp.logical_and(tree_finite(params),
                                          jnp.all(jnp.isfinite(losses)))
-                valid = my_alive * finite.astype(jnp.float32)
+                finite = finite.astype(jnp.float32)
+                if intra > 1:
+                    finite = jax.lax.pmin(finite, axis)
+                valid = my_alive * finite
             else:
                 valid = my_alive
-            # collect & average (CifarApp.scala:131-133) == one masked
-            # weighted average (== pmean when all workers are valid) —
+            # the per-worker (per-host, hierarchically) round loss: mean
+            # over tau steps, folded over the host's devices
+            local_loss = intra_mean(jnp.mean(losses))
+            # tier 2, per ROUND — collect & average
+            # (CifarApp.scala:131-133) == one masked weighted average
+            # over sync_axis (== pmean when all workers are valid) —
             # with stats on, masked_consensus_stats IS that average plus
             # each live worker's drift from the result (the paper's tau
             # drift), and ref_sq is the consensus round update's sq norm
             if with_stats:
-                params, aux = masked_consensus_stats(params, valid, axis)
+                params, aux = masked_consensus_stats(params, valid,
+                                                     sync_axis)
                 aux["ref_sq"] = tree_sq_dist(params, params_in)[1]
-                aux["worker_loss"] = gather_worker_scalar(
-                    jnp.mean(losses), axis)
+                aux["worker_loss"] = gather_worker_scalar(local_loss,
+                                                          sync_axis)
             elif elastic_on:
-                params, n_live = masked_consensus(params, valid, axis)
-                aux = {"valid": jax.lax.all_gather(valid, axis),
+                params, n_live = masked_consensus(params, valid, sync_axis)
+                aux = {"valid": jax.lax.all_gather(valid, sync_axis),
                        "n_live": n_live,
-                       "worker_loss": gather_worker_scalar(
-                           jnp.mean(losses), axis)}
+                       "worker_loss": gather_worker_scalar(local_loss,
+                                                           sync_axis)}
             else:
-                params, _ = masked_consensus(params, valid, axis)
+                params, _ = masked_consensus(params, valid, sync_axis)
                 aux = {}
-            state, _ = masked_consensus(state, valid, axis)
+            # BN running stats differ per device (each saw its own
+            # shard): fold within the host first, then the masked
+            # cross-host consensus
+            state, _ = masked_consensus(intra_mean(state), valid, sync_axis)
             if average_history:
-                history, _ = masked_consensus(history, valid, axis)
+                # history is already replicated within a host (identical
+                # pmean'd grads drive identical updates), so only the
+                # cross-host average is needed
+                history, _ = masked_consensus(history, valid, sync_axis)
             # the round loss is the mean over the LIVE workers' tau
             # steps — without the collective the P() out_spec would hand
             # back whichever worker's mean sits on the fetching host's
             # first device (observably different across hosts/modes)
             return params, state, history, \
-                masked_scalar_mean(jnp.mean(losses), valid, axis), aux
+                masked_scalar_mean(local_loss, valid, sync_axis), aux
 
-        bspec = _batch_specs(batch_example, axis, batch_dim=1)
+        shard_axes = (host_axis, axis) if host_axis is not None else axis
+        bspec = _batch_specs(batch_example, shard_axes, batch_dim=1)
+        world_kw = dict(axis=axis, size=self.mesh.shape[axis],
+                        elastic=elastic_on)
+        if host_axis is not None:
+            world_kw.update(host_axis=host_axis, hosts=n_workers)
         with context.axis_context(data=axis), \
-                context.world_context(axis=axis, size=n_workers,
-                                      elastic=elastic_on):
+                context.world_context(**world_kw):
             sharded = shard_map(
                 round_fn, mesh=self.mesh,
                 in_specs=(P(), P(), P(), bspec, P(), P(), P()),
@@ -511,21 +597,35 @@ class LocalSGDSolver(Solver):
 
     def _register_comms(self, cm):
         """The SparkNet tradeoff itself: ONE param-sized averaging pmean
-        per tau-step round (vs. DP's per-step grad allreduce)."""
+        per tau-step round (vs. DP's per-step grad allreduce). In
+        hierarchical mode the round average crosses hosts (DCN) while a
+        per-step gradient pmean stays inside each host (ICI) — both
+        registered so the report shows the two tiers' volumes apart."""
         from ..obs.comms import (tree_bytes, ring_allreduce_bytes,
                                  broadcast_collect_bytes)
         super()._register_comms(cm)
-        n = self.mesh.shape[self.axis]
+        sync_axis = self.host_axis if self.host_axis is not None \
+            else self.axis
+        n = self.mesh.shape[sync_axis]
         pb = tree_bytes(self.params) + tree_bytes(self.state)
         if self.average_history:
             pb += tree_bytes(self.history)
         cm.set_topology(axes=dict(self.mesh.shape), tau=self.tau)
         cm.register(
-            "param_average", ring_allreduce_bytes(pb, n), axis=self.axis,
+            "param_average", ring_allreduce_bytes(pb, n), axis=sync_axis,
             steps_per_round=self.tau,
             note="one weight-averaging pmean per tau-step round "
-                 "(the paper's broadcast+collect)",
+                 "(the paper's broadcast+collect)"
+                 + (" across hosts" if self.host_axis is not None else ""),
             paper_broadcast_collect_bytes=broadcast_collect_bytes(pb, n))
+        if self.host_axis is not None and self.mesh.shape[self.axis] > 1:
+            gb = tree_bytes(self.params)
+            cm.register(
+                "intra_host_grad_pmean",
+                ring_allreduce_bytes(gb, self.mesh.shape[self.axis]),
+                axis=self.axis, steps_per_round=1,
+                note="per-step gradient pmean inside each fault domain "
+                     "(tier 1 of hierarchical local SGD)")
 
     def _round_latencies(self, round_s):
         """Per-worker latencies for the finished round. A single fused
@@ -533,13 +633,17 @@ class LocalSGDSolver(Solver):
         the round wall time for every worker; a chaos-injected stall with
         a worker attribution (stall_worker=W) lands its seconds on W
         alone — its peers finished a stall early, exactly the shape a
-        per-host timer would report for a real straggler."""
-        n = self.mesh.shape[self.axis]
+        per-host timer would report for a real straggler. In
+        hierarchical mode the vector is per-HOST (the membership unit),
+        and a chaos slow_host's injected seconds land on that host."""
+        n = self.mesh.shape[self.elastic_axis]
         if n <= 1 or round_s is None:
             return None
         lat = [float(round_s)] * n
         if self.chaos is not None:
             rep = self.chaos.pop_stall()
+            if self.host_axis is not None:
+                rep = self.chaos.pop_slow_host() or rep
             if rep and rep[0] is not None and 0 <= rep[0] < n:
                 w, sec = rep
                 base = max(0.0, float(round_s) - float(sec))
@@ -559,20 +663,29 @@ class LocalSGDSolver(Solver):
         changed."""
         if self.elastic is None:
             raise ValueError("shrink_to_survivors needs arm_elastic()")
-        if len(self.mesh.shape) != 1:
+        if self.host_axis is None and len(self.mesh.shape) != 1:
             raise ValueError("mesh shrink supports pure data-axis meshes")
         live = self.elastic.live()
-        old = self.mesh.shape[self.axis]
+        old = self.mesh.shape[self.elastic_axis]
         if len(live) == old:
             return False
-        from .mesh import make_mesh
-        devices = list(self.mesh.devices.reshape(-1)[live])
+        if self.host_axis is not None:
+            # hierarchical: drop the dead HOST rows. When only this
+            # process's row survives, the result is a purely local mesh
+            # and later rounds never touch the cross-host fabric a dead
+            # peer would hang (parallel.multihost.survivor_mesh).
+            from .multihost import survivor_mesh
+            new_mesh = survivor_mesh(self.mesh, live, device_axis=self.axis)
+        else:
+            from .mesh import make_mesh
+            devices = list(self.mesh.devices.reshape(-1)[live])
+            new_mesh = make_mesh({self.axis: len(live)}, devices=devices)
         # host round trip: donated buffers live on the OLD mesh; numpy
         # copies re-place cleanly when the shrunk round first runs
         self.params = jax.device_get(self.params)
         self.state = jax.device_get(self.state)
         self.history = jax.device_get(self.history)
-        self.mesh = make_mesh({self.axis: len(live)}, devices=devices)
+        self.mesh = new_mesh
         self._jit_round = None
         self._jit_train = None
         self._jit_eval = None
@@ -580,22 +693,153 @@ class LocalSGDSolver(Solver):
         self.elastic.reset_world(len(live))
         if self.metrics is not None:
             self.metrics.log("membership", kind="mesh_shrunk",
-                             from_world=old, to_world=len(live))
-        self.log(f"elastic: mesh shrunk {old} -> {len(live)} workers; "
-                 "the next round recompiles at the new world size")
+                             from_world=old, to_world=len(live),
+                             unit=self.elastic_unit)
+        self.log(f"elastic: mesh shrunk {old} -> {len(live)} "
+                 f"{self.elastic_unit}s; the next round recompiles at "
+                 "the new world size")
         return True
+
+    def _mesh_host_procs(self):
+        """mesh host row -> owning process id (None when a row's
+        devices span processes, or on 1-D meshes)."""
+        if self.host_axis is None:
+            return None
+        rows = self.mesh.devices
+        procs = []
+        for h in range(rows.shape[0]):
+            owners = {d.process_index for d in rows[h].flat}
+            procs.append(owners.pop() if len(owners) == 1 else None)
+        return procs
+
+    def _heartbeat_gate(self):
+        """The no-hang contract: arrive at this round's rendezvous and
+        wait until every live peer host arrived or its lease expired.
+        Lease-dead hosts are evicted at host granularity (zero
+        recompiles — the alive mask is an input); when a dead PROCESS
+        owns devices of the training mesh, the survivors additionally
+        shrink the mesh before dispatching, because a collective over a
+        dead process's devices would hang forever. QuorumLost
+        propagates to run(), which drives the coordinated restart."""
+        from ..resilience.elastic import QuorumLost
+        hb = self.heartbeat
+        if self.elastic is not None and self.elastic.n == hb.n:
+            expect = set(self.elastic.live())
+        else:
+            expect = set(range(hb.n))
+        res = hb.gate(self._round_idx, expect=expect)
+        if self.health is not None:
+            alive_now, ages = hb.view()
+            self.health.observe_hosts(self._round_idx, alive=alive_now,
+                                      lease_age_s=ages,
+                                      lease_s=hb.lease_s,
+                                      wait_s=res.wait_s)
+        quorum_err = None
+        for h in res.dead:
+            if self.elastic is None or not (0 <= h < self.elastic.n):
+                continue
+            try:
+                self.elastic.evict(h, self._round_idx, "lease_expired")
+            except QuorumLost as e:
+                quorum_err = e          # survivors still shrink/snapshot
+        if res.dead and self.host_axis is not None and \
+                jax.process_count() > 1 and self._relay is None:
+            from .mesh import is_local_mesh
+            if not is_local_mesh(self.mesh):
+                procs = self._mesh_host_procs()
+                dead_rows = [h for h, p in enumerate(procs)
+                             if p in res.dead]
+                if dead_rows and quorum_err is None and \
+                        self.elastic is not None:
+                    self.shrink_to_survivors()
+        if quorum_err is not None:
+            raise quorum_err
+
+    def _train_round_relay(self, batches):
+        """The cross-host tier over the rendezvous directory
+        (heartbeat.FileConsensus): run the LOCAL compiled round (tier 1
+        — this fault domain's devices, per-step pmean), then post the
+        result and adopt the masked cross-host average. Same math as
+        the compiled masked consensus, on the transport the paper
+        itself used (a driver-mediated collect & broadcast every tau
+        steps)."""
+        import math as _m
+        import time as _t
+        t0 = _t.perf_counter()
+        if self._jit_round is None:
+            self._jit_round = self._build_round(batches)
+        self.rng, key = jax.random.split(self.rng)
+        shard_axes = (self.host_axis, self.axis) \
+            if self.host_axis is not None else self.axis
+        dev = shard_batch(batches, self.mesh, shard_axes, batch_dim=1)
+        self.params, self.state, self.history, loss, _ = self._jit_round(
+            self.params, self.state, self.history, dev,
+            jnp.asarray(self.iter, jnp.int32), key, self._alive_mask())
+        self.iter += self.tau
+        # tier 2: fetch (replicated locally — one local device read),
+        # exchange through the directory, adopt the consensus
+        leaves_p, tdef_p = jax.tree_util.tree_flatten(
+            jax.device_get(self.params))
+        leaves_s, tdef_s = jax.tree_util.tree_flatten(
+            jax.device_get(self.state))
+        payload = [np.asarray(x) for x in leaves_p + leaves_s]
+        tdef_h = None
+        if self.average_history:
+            leaves_h, tdef_h = jax.tree_util.tree_flatten(
+                jax.device_get(self.history))
+            payload += [np.asarray(x) for x in leaves_h]
+        local_loss = float(jax.device_get(loss))
+        valid = _m.isfinite(local_loss) and \
+            all(np.all(np.isfinite(x)) for x in payload)
+        alive = self.elastic.live() if self.elastic is not None \
+            else list(range(self.heartbeat.n))
+        consensus, aux = self._relay.exchange(
+            self._round_idx, payload, valid, local_loss, alive)
+        np_ = len(leaves_p)
+        ns = np_ + len(leaves_s)
+        self.params = jax.tree_util.tree_unflatten(tdef_p, consensus[:np_])
+        self.state = jax.tree_util.tree_unflatten(tdef_s,
+                                                  consensus[np_:ns])
+        if tdef_h is not None:
+            self.history = jax.tree_util.tree_unflatten(tdef_h,
+                                                        consensus[ns:])
+        wl = np.asarray(aux["worker_loss"], np.float64)
+        vv = np.asarray(aux["valid"], np.float64) > 0
+        round_loss = float(np.nanmean(wl[vv])) if vv.any() \
+            else local_loss
+        host_s = _t.perf_counter() - t0
+        self._timing["train_round"] += host_s
+        self._obs_step(host_s, round_loss, batches)
+        out = self._chaos_loss(jnp.float32(round_loss))
+        self._observe_sync_round(
+            dict(aux, kind="params"),
+            round_s=_t.perf_counter() - t0, round_idx=self._round_idx)
+        self._round_idx += 1
+        return out
 
     def train_round(self, batches):
         """One outer round. ``batches``: dict of arrays with leading axes
         (tau, global_batch, ...) — tau steps, batch dim sharded across
-        workers. Returns mean per-worker loss over the round."""
+        workers (over host x device in hierarchical mode; multi-process
+        callers feed their own host rows). Returns mean per-worker loss
+        over the round."""
         import time as _t
         batches = {k: np.asarray(v) for k, v in batches.items()}
+        if self.heartbeat is not None:
+            # the round gate: never dispatch a cross-host collective
+            # until every supposedly-live peer host has arrived (or its
+            # lease expired and it was evicted) — a dead peer must cost
+            # an eviction, not a hang inside the collective
+            self._heartbeat_gate()
+        if self._relay is not None:
+            return self._train_round_relay(batches)
         if self._jit_round is None:
             self._jit_round = self._build_round(batches)
         self.rng, key = jax.random.split(self.rng)
         t0 = _t.perf_counter()
-        dev = shard_batch(batches, self.mesh, self.axis, batch_dim=1)
+        shard_axes = (self.host_axis, self.axis) \
+            if self.host_axis is not None else self.axis
+        dev = shard_batch(batches, self.mesh, shard_axes, batch_dim=1)
         self.params, self.state, self.history, loss, aux = self._jit_round(
             self.params, self.state, self.history, dev,
             jnp.asarray(self.iter, jnp.int32), key, self._alive_mask())
@@ -663,9 +907,14 @@ class LocalSGDSolver(Solver):
                 try:
                     loss = self.train_round(batch_fn(self.tau))
                 except QuorumLost:
-                    # the consensus up to here is good — keep it
+                    # the consensus up to here is good — keep it. The
+                    # designated writer commits it; every survivor then
+                    # barriers on the manifest's sha256 (coordinated
+                    # restart), so all of them exit 4 holding the SAME
+                    # resumable snapshot for the supervisor relaunch.
                     if prefix:
                         self.snapshot(prefix=prefix)
+                        self.coordinated_restart(prefix)
                     raise
                 if self.elastic is not None and self.elastic.should_shrink():
                     self.shrink_to_survivors()

@@ -20,7 +20,7 @@ import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..solver.solver import Solver
-from .mesh import DATA_AXIS, MODEL_AXIS
+from .mesh import DATA_AXIS, MODEL_AXIS, HOST_AXIS
 
 
 def default_param_rule(axis_size, min_size=2 ** 14):
@@ -46,8 +46,15 @@ class GSPMDSolver(Solver):
         from .mesh import make_mesh
         self.mesh = mesh if mesh is not None else \
             make_mesh({DATA_AXIS: -1, MODEL_AXIS: 1})
-        msize = self.mesh.shape.get(MODEL_AXIS, 1)
-        self.param_rule = param_rule or default_param_rule(msize)
+        if param_rule is not None:
+            self.param_rule = param_rule
+        elif MODEL_AXIS in self.mesh.shape:
+            self.param_rule = default_param_rule(
+                self.mesh.shape[MODEL_AXIS])
+        else:
+            # no tensor-parallel axis on this mesh (e.g. the (host,
+            # data) fault-domain mesh): replicate every weight blob
+            self.param_rule = lambda lname, i, shape: P()
         # optional third axis: shard dim 1 (sequence) of rank>=2 feed
         # blobs — the annotation-style sp that composes dp x tp x sp on
         # one mesh. XLA's SPMD partitioner places the attention/loss
@@ -81,15 +88,20 @@ class GSPMDSolver(Solver):
                       for l, arrs in self.state.items()}
 
     def _batch_sharding(self, batch):
+        # a 2-D (host, data) mesh (parallel.multihost.host_mesh) shards
+        # the batch dim over host x data — the fault-domain-major layout
+        # where each host's processes feed their own rows
+        batch_axes = (HOST_AXIS, DATA_AXIS) \
+            if HOST_AXIS in self.mesh.shape else DATA_AXIS
         out = {}
         for k, v in batch.items():
             nd = np.ndim(v)
             if not nd:
                 spec = P()
             elif self.seq_axis is not None and nd >= 2:
-                spec = P(DATA_AXIS, self.seq_axis)
+                spec = P(batch_axes, self.seq_axis)
             else:
-                spec = P(DATA_AXIS)
+                spec = P(batch_axes)
             out[k] = NamedSharding(self.mesh, spec)
         return out
 

@@ -8,6 +8,9 @@ strategies. Axis names used throughout the framework:
   "model"  tensor parallelism (reserved; used by sharded InnerProduct)
   "seq"    sequence/context parallelism (ring attention)
   "pipe"   pipeline parallelism (reserved)
+  "host"   host fault domains (hierarchical local SGD: per-step pmean
+           inside a host over "data", tau-interval masked averaging
+           across "host" — see parallel/multihost.py)
 """
 
 import os
@@ -20,6 +23,7 @@ DATA_AXIS = "data"
 MODEL_AXIS = "model"
 SEQ_AXIS = "seq"
 PIPE_AXIS = "pipe"
+HOST_AXIS = "host"
 
 
 def make_mesh(axes=None, devices=None):
@@ -51,6 +55,51 @@ def mesh_axis_size(mesh, axis):
     return mesh.shape[axis] if axis in mesh.shape else 1
 
 
+def make_host_device_mesh(hosts=None, per_host=None, device_axis=DATA_AXIS,
+                          devices=None):
+    """Build the 2-D ``(host, device)`` mesh the hierarchical runtime
+    trains on: axis "host" indexes fault domains (normally one jax
+    process each), ``device_axis`` (default "data") the devices inside
+    one. Row h of the mesh holds host h's local devices, so the "host"
+    collectives cross DCN and the inner per-step pmean stays on ICI.
+
+    Multi-process: hosts defaults to jax.process_count(), per_host to
+    the local device count, and devices are grouped by owning process.
+    Single-process: hosts x per_host partitions the local devices into
+    VIRTUAL fault domains — how the tests (and laptop runs) exercise the
+    two-tier path without a pod."""
+    devices = list(devices if devices is not None else jax.devices())
+    # group rows by owning process: jax.devices() order is not
+    # contractually process-major, the mesh layout must be
+    devices.sort(key=lambda d: (d.process_index, d.id))
+    if hosts is None:
+        hosts = jax.process_count()
+    hosts = int(hosts)
+    if hosts < 1:
+        raise ValueError(f"need >= 1 host, got {hosts}")
+    if per_host is None:
+        if len(devices) % hosts:
+            raise ValueError(f"{len(devices)} devices not divisible by "
+                             f"{hosts} hosts")
+        per_host = len(devices) // hosts
+    per_host = int(per_host)
+    need = hosts * per_host
+    if need > len(devices):
+        raise ValueError(f"host mesh {hosts}x{per_host} needs {need} "
+                         f"devices, have {len(devices)}")
+    arr = np.array(devices[:need]).reshape(hosts, per_host)
+    return Mesh(arr, (HOST_AXIS, device_axis))
+
+
+def is_local_mesh(mesh):
+    """True when every device of ``mesh`` belongs to THIS process —
+    compiled programs over it never touch the cross-host fabric, so a
+    surviving host can keep training after its peers died (the
+    shrink-to-survivors path of the hierarchical runtime)."""
+    me = jax.process_index()
+    return all(d.process_index == me for d in mesh.devices.flat)
+
+
 def distributed_init(coordinator_address=None, num_processes=None,
                      process_id=None):
     """Multi-host bring-up over DCN — the analog of the reference's
@@ -67,13 +116,25 @@ def distributed_init(coordinator_address=None, num_processes=None,
         process_id = int(pid) if pid is not None else None
     if coordinator_address is None and num_processes is None:
         return False  # single-process
-    state = getattr(jax.distributed, "global_state", None)
-    if state is not None and getattr(state, "client", None) is not None:
+    if distributed_initialized():
         return True   # already initialized (CLI + app both call this)
     jax.distributed.initialize(coordinator_address=coordinator_address,
                                num_processes=num_processes,
                                process_id=process_id)
     return True
+
+
+def distributed_initialized():
+    """Has jax.distributed been brought up in this process? The public
+    module does not re-export the client state on every jax vintage, so
+    probe the private module too (a second initialize raises)."""
+    state = getattr(jax.distributed, "global_state", None)
+    if state is None:
+        try:
+            from jax._src.distributed import global_state as state
+        except Exception:
+            state = None
+    return state is not None and getattr(state, "client", None) is not None
 
 
 def local_batch_slice(global_batch_size, mesh=None, axis=DATA_AXIS):

@@ -1,0 +1,181 @@
+"""Multi-host runtime: process topology, fault-domain meshes, shrink.
+
+The reference's cluster substrate was a Spark driver owning N executors;
+one executor loss killed the job (spark.task.maxFailures=1). Here the
+substrate is jax.distributed — N identical processes, each owning the
+local devices of one machine — and the HOST is the real failure unit:
+preemption, OOM-kill, and network partitions take out whole processes,
+never single chips. This module is the thin runtime layer the rest of
+the framework builds fault domains on:
+
+  init_runtime()        wraps jax.distributed bring-up (mesh.
+                        distributed_init) and publishes the process
+                        topology through parallel/context.py — one
+                        authoritative (process_id, local/global device
+                        topology) record per process
+  host_mesh()           the 2-D (host, device) training mesh of
+                        mesh.make_host_device_mesh, one row per fault
+                        domain
+  survivor_mesh()       the mesh rebuilt over the LIVE hosts' devices
+                        after evictions — falls back to this process's
+                        local devices when the survivors can no longer
+                        span hosts (the single-survivor case)
+  local_batch_rows()    this host's slice of a slot-major global batch
+
+The liveness signals that drive evictions live in
+resilience/heartbeat.py (leased heartbeats over a shared directory);
+this module only knows topology.
+"""
+
+import os
+
+import numpy as np
+import jax
+
+from . import context
+from .mesh import (HOST_AXIS, DATA_AXIS, distributed_init,
+                   make_host_device_mesh, is_local_mesh)
+
+
+def needs_host_relay():
+    """True when the cross-host tier cannot run as an in-program
+    collective on this backend — multi-process CPU jax has no
+    cross-host collective transport ("Multiprocess computations aren't
+    implemented on the CPU backend"), so the tau-interval average must
+    go through the rendezvous directory instead
+    (resilience.heartbeat.FileConsensus). TPU/GPU pods use the
+    compiled collective path."""
+    if jax.process_count() <= 1:
+        return False
+    return jax.devices()[0].platform == "cpu"
+
+
+def init_runtime(coordinator_address=None, num_processes=None,
+                 process_id=None):
+    """Bring up (or join) the multi-host runtime and publish this
+    process's topology. Idempotent; single-process runs publish the
+    trivial one-host topology. Returns the topology dict."""
+    distributed_init(coordinator_address=coordinator_address,
+                     num_processes=num_processes, process_id=process_id)
+    return publish_topology()
+
+
+def publish_topology():
+    """(Re)derive this process's host topology from jax and publish it
+    through parallel/context.py."""
+    info = {
+        "process_id": jax.process_index(),
+        "num_processes": jax.process_count(),
+        "local_device_count": jax.local_device_count(),
+        "global_device_count": jax.device_count(),
+        "platform": jax.devices()[0].platform if jax.devices() else None,
+    }
+    return context.publish_host_topology(info)
+
+
+def host_mesh(hosts=None, per_host=None, device_axis=DATA_AXIS):
+    """The (host, device) training mesh for the current topology (or a
+    virtual hosts x per_host partition of the local devices)."""
+    return make_host_device_mesh(hosts=hosts, per_host=per_host,
+                                 device_axis=device_axis)
+
+
+def survivor_mesh(mesh, live_hosts, device_axis=None):
+    """Rebuild a (host, device) mesh over the LIVE host rows.
+
+    When the surviving rows include this process's devices only — the
+    lone-survivor case, or a partition where the remote survivors are
+    unreachable anyway — the result is a purely local mesh
+    (is_local_mesh), so subsequent compiled rounds never block on the
+    cross-host fabric a dead peer would hang."""
+    if mesh.devices.ndim != 2:
+        raise ValueError("survivor_mesh needs a (host, device) mesh")
+    device_axis = device_axis or mesh.axis_names[1]
+    live = sorted(int(h) for h in live_hosts)
+    if not live:
+        raise ValueError("no live hosts to rebuild a mesh over")
+    rows = mesh.devices[np.asarray(live)]
+    return make_host_device_mesh(hosts=rows.shape[0],
+                                 per_host=rows.shape[1],
+                                 device_axis=device_axis,
+                                 devices=list(rows.flat))
+
+
+def my_host_rows(mesh):
+    """Host-axis indices of ``mesh`` whose devices THIS process owns —
+    the rows this process feeds (normally exactly one in a real
+    multi-process run; all of them on a virtual single-process mesh)."""
+    me = jax.process_index()
+    rows = []
+    for h in range(mesh.devices.shape[0]):
+        if all(d.process_index == me for d in mesh.devices[h]):
+            rows.append(h)
+    return rows
+
+
+def local_batch_rows(global_batch, mesh):
+    """(start, size) of this process's contiguous slice of a batch axis
+    sharded over (host, device): host h's devices hold blocks
+    [h*per_host, (h+1)*per_host), so a process feeding its own rows
+    ships exactly its devices' data (the per-worker RDD partition of
+    CifarApp.scala:56-64, at host granularity)."""
+    hosts, per_host = mesh.devices.shape
+    slots = hosts * per_host
+    if global_batch % slots:
+        raise ValueError(f"global batch {global_batch} not divisible by "
+                         f"{slots} mesh slots")
+    per_slot = global_batch // slots
+    rows = my_host_rows(mesh)
+    if not rows:
+        raise ValueError("this process owns no complete host row of the "
+                         "mesh (hosts must not straddle processes)")
+    if rows != list(range(rows[0], rows[0] + len(rows))):
+        raise ValueError(f"this process's host rows {rows} are not "
+                         "contiguous on the host axis")
+    return rows[0] * per_host * per_slot, len(rows) * per_host * per_slot
+
+
+def exit_if_peers_died(rc, heartbeat):
+    """Exit code ``rc`` WITHOUT the jax.distributed atexit shutdown —
+    call at the very end of a CLI run (after metrics are flushed) when
+    the heartbeat layer saw a peer host die. The coordination service's
+    shutdown barrier waits for every task; with a dead peer it can only
+    time out and SIGABRT the process, turning a successfully-survived
+    run into exit 134. The supervisor contract (DEPLOY.md) is the rc of
+    the RUN, so the survivor skips the doomed barrier. No-op (returns)
+    when single-process or no host ever died."""
+    if heartbeat is None or jax.process_count() <= 1:
+        return
+    try:
+        dead = heartbeat.ever_dead()
+    except Exception:
+        dead = None
+    if not dead:
+        return
+    import sys
+    print(f"multihost: peer host(s) {sorted(dead)} died this run; "
+          f"exiting {rc} without the distributed shutdown barrier",
+          flush=True)
+    sys.stdout.flush()
+    sys.stderr.flush()
+    os._exit(rc)
+
+
+def auto_host_mesh(hosts=None, per_host=None, device_axis=DATA_AXIS):
+    """The right (host, device) mesh for this runtime: the global
+    host-major mesh when the backend can run cross-host collectives, a
+    LOCAL one-row mesh when the cross-host tier must relay through the
+    rendezvous directory (needs_host_relay) — each process then trains
+    its own fault domain and the relay supplies the tau-consensus."""
+    if needs_host_relay():
+        return make_host_device_mesh(hosts=1, per_host=per_host,
+                                     device_axis=device_axis,
+                                     devices=jax.local_devices())
+    return make_host_device_mesh(hosts=hosts, per_host=per_host,
+                                 device_axis=device_axis)
+
+
+__all__ = ["init_runtime", "publish_topology", "host_mesh",
+           "survivor_mesh", "my_host_rows", "local_batch_rows",
+           "HOST_AXIS", "is_local_mesh", "needs_host_relay",
+           "auto_host_mesh", "exit_if_peers_died"]

@@ -30,7 +30,17 @@ diverging loss costs at most one sync round, never the run:
                  evicts sick workers, re-spreads their data shard over
                  the survivors, readmits them after a cooldown, and
                  aborts with QuorumLost / exit EXIT_QUORUM_LOST (4) when
-                 the live count drops below --quorum
+                 the live count drops below --quorum — at device-worker
+                 OR host granularity (unit="host")
+  heartbeat.py   host-level fault domains: every process leases its
+                 liveness into a shared rendezvous directory, a monitor
+                 marks peer hosts dead on lease expiry, the pre-round
+                 gate guarantees a dead peer costs an eviction instead
+                 of a hang inside a collective, FileConsensus relays
+                 the tau-interval cross-host average through the
+                 directory when the backend has no multi-process
+                 collectives, and restart_barrier makes every survivor
+                 exit 4 agreeing on the SAME resumable manifest
 
 Everything reports through the run's MetricsLogger (events: checkpoint,
 recovery, retry, chaos, eviction, readmission, membership), so
@@ -39,21 +49,27 @@ curve they interrupted.
 """
 
 from .checkpoint import (save_snapshot, find_resumable, resume_auto,
-                         load_manifest, manifest_path, check_restorable)
+                         load_manifest, manifest_path, check_restorable,
+                         wait_for_manifest, world_signature, WorldMismatch)
 from .recovery import RecoveryPolicy, RecoveryAbort
 from .retry import RetryPolicy, RetryExhausted, retry_from_env
 from .chaos import ChaosMonkey, ChaosIOError, install_chaos, active_chaos
 from .elastic import (ElasticPolicy, QuorumLost, EXIT_QUORUM_LOST,
                       masked_consensus, masked_consensus_stats,
                       masked_scalar_mean, tree_finite, expand_to_slots)
+from .heartbeat import (HeartbeatCoordinator, FileConsensus, GateResult,
+                        manifest_sha, restart_barrier)
 
 __all__ = [
     "save_snapshot", "find_resumable", "resume_auto", "load_manifest",
     "manifest_path", "check_restorable",
+    "wait_for_manifest", "world_signature", "WorldMismatch",
     "RecoveryPolicy", "RecoveryAbort",
     "RetryPolicy", "RetryExhausted", "retry_from_env",
     "ChaosMonkey", "ChaosIOError", "install_chaos", "active_chaos",
     "ElasticPolicy", "QuorumLost", "EXIT_QUORUM_LOST",
     "masked_consensus", "masked_consensus_stats", "masked_scalar_mean",
     "tree_finite", "expand_to_slots",
+    "HeartbeatCoordinator", "FileConsensus", "GateResult",
+    "manifest_sha", "restart_barrier",
 ]

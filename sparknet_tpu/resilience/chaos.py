@@ -26,6 +26,25 @@ subsystem claims to survive — on a schedule tests can replay exactly:
   dead_p=P         each live worker independently crashes with
                    probability P at every round (seeded rng; a crashed
                    worker stays crashed until the policy readmits it)
+  kill_host=H, kill_host_round=R   host (fault domain) H dies at round R
+                   (once; R defaults to 0). In a real multi-process run
+                   the targeted process SIGKILLs ITSELF at the round
+                   gate, before announcing arrival — survivors see a
+                   lease expiry, the true crash shape; in virtual
+                   single-process host meshes the host is marked dead
+                   like kill_worker. Exercises host eviction, the
+                   no-hang gate, and coordinated restart.
+  partition_host=H, partition_round=R   from round R, host H and the
+                   rest of the fleet stop seeing each other's
+                   heartbeats (both sides of the split independently
+                   conclude the other is gone — the quorum breaks the
+                   symmetry: the majority side keeps training, the
+                   minority side exits 4)
+  slow_host=H, slow_host_s=S, slow_host_round=R, slow_repeat=1
+                   host H arrives S seconds late at the round gate
+                   (once at round R, or every round with slow_repeat) —
+                   the host-granularity straggler the health detectors
+                   must name
 
 Armed via `--chaos "nan_step=30,io_p=0.02,seed=1"` or the SPARKNET_CHAOS
 env var (same spec), which data sources and solvers pick up through
@@ -70,6 +89,10 @@ class ChaosMonkey:
                  stall_step=None, stall_s=0.0, stall_worker=None,
                  stall_repeat=False, sigterm_round=None,
                  kill_worker=None, kill_round=0, dead_p=0.0,
+                 kill_host=None, kill_host_round=0,
+                 partition_host=None, partition_round=0,
+                 slow_host=None, slow_host_s=0.0, slow_host_round=0,
+                 slow_repeat=False,
                  seed=0, metrics=None, log_fn=print):
         self.nan_step = None if nan_step is None else int(nan_step)
         self.nan_repeat = bool(nan_repeat)
@@ -86,6 +109,24 @@ class ChaosMonkey:
         self.dead_p = float(dead_p)
         self._kill_fired = False
         self._dead = set()      # workers dead_p has already crashed
+        # host-granularity injectors (fault domains; resilience/heartbeat)
+        self.kill_host = None if kill_host is None else int(kill_host)
+        self.kill_host_round = int(kill_host_round)
+        self._host_kill_fired = False
+        # set by a multi-process HeartbeatCoordinator: the target
+        # process SIGKILLs itself (maybe_kill_self), so the virtual
+        # dead_hosts rendering must not double-fire on survivors
+        self.kill_host_self_mode = False
+        self.partition_host = None if partition_host is None \
+            else int(partition_host)
+        self.partition_round = int(partition_round)
+        self._partition_logged = False
+        self.slow_host = None if slow_host is None else int(slow_host)
+        self.slow_host_s = float(slow_host_s)
+        self.slow_host_round = int(slow_host_round)
+        self.slow_repeat = bool(slow_repeat)
+        self._slow_fired = False
+        self._last_slow = None
         self._rng = np.random.RandomState(seed)
         self.metrics = metrics
         self.log = log_fn or (lambda *a: None)
@@ -113,7 +154,12 @@ class ChaosMonkey:
                  "stall_step": int, "stall_s": float,
                  "stall_worker": int, "stall_repeat": truthy,
                  "sigterm_round": int, "kill_worker": int,
-                 "kill_round": int, "dead_p": float, "seed": int}
+                 "kill_round": int, "dead_p": float,
+                 "kill_host": int, "kill_host_round": int,
+                 "partition_host": int, "partition_round": int,
+                 "slow_host": int, "slow_host_s": float,
+                 "slow_host_round": int, "slow_repeat": truthy,
+                 "seed": int}
         unknown = set(fields) - set(known)
         if unknown:
             raise ValueError(f"unknown chaos keys {sorted(unknown)} "
@@ -200,3 +246,75 @@ class ChaosMonkey:
             self._term_fired = True
             self._event("sigterm", round=round_)
             os.kill(os.getpid(), signal.SIGTERM)
+
+    # -- host-granularity injectors (fault domains) ------------------------
+    def dead_hosts(self, round_, n_hosts):
+        """Host ids newly "crashed" at round ``round_`` — the virtual
+        (single-process host mesh) rendering of kill_host, consumed by
+        an ElasticPolicy(unit="host") exactly like dead_workers."""
+        out = []
+        if self.kill_host is not None and not self._host_kill_fired \
+                and not self.kill_host_self_mode \
+                and round_ >= self.kill_host_round:
+            self._host_kill_fired = True
+            if 0 <= self.kill_host < n_hosts:
+                self._event("kill_host", host=self.kill_host, round=round_)
+                out.append(self.kill_host)
+        return out
+
+    def maybe_kill_self(self, host, round_, on_kill=None):
+        """The REAL multi-process rendering of kill_host: the targeted
+        process dies by SIGKILL at the round gate, before announcing
+        arrival — no cleanup, no snapshot, exactly what a preemption or
+        OOM kill looks like to the survivors (lease expiry). ``on_kill``
+        runs first (stop heartbeating so the last lease predates the
+        corpse)."""
+        if self.kill_host is None or host != self.kill_host \
+                or round_ < self.kill_host_round or self._host_kill_fired:
+            return False
+        self._host_kill_fired = True
+        self._event("kill_host", host=host, round=round_, via="SIGKILL")
+        if on_kill is not None:
+            try:
+                on_kill()
+            except Exception:
+                pass
+        os.kill(os.getpid(), signal.SIGKILL)
+        return True                           # not reached
+
+    def host_partitioned(self, a, b, round_):
+        """True when hosts ``a`` and ``b`` can't see each other's
+        heartbeats at ``round_`` — partition_host cuts the target off
+        from the whole fleet (both directions)."""
+        if self.partition_host is None or round_ < self.partition_round \
+                or round_ < 0:
+            return False
+        cut = a != b and self.partition_host in (a, b)
+        if cut and not self._partition_logged:
+            self._partition_logged = True
+            self._event("partition_host", host=self.partition_host,
+                        round=round_)
+        return cut
+
+    def maybe_slow_host(self, host, round_):
+        """Delay host ``host`` by slow_host_s at the round gate (once at
+        slow_host_round, every round with slow_repeat). Returns the
+        injected seconds; pop_slow_host() reports the attribution."""
+        if self.slow_host is None or host != self.slow_host \
+                or round_ < self.slow_host_round or self.slow_host_s <= 0:
+            return 0.0
+        if self._slow_fired and not self.slow_repeat:
+            return 0.0
+        self._slow_fired = True
+        self._event("slow_host", host=host, round=round_,
+                    seconds=self.slow_host_s)
+        self._last_slow = (host, self.slow_host_s)
+        time.sleep(self.slow_host_s)
+        return self.slow_host_s
+
+    def pop_slow_host(self):
+        """(host, seconds) of the slow-host injection since the last
+        call, or None — how the round-latency probe attributes the
+        host-granularity straggler."""
+        rep, self._last_slow = self._last_slow, None
+        return rep

@@ -35,8 +35,64 @@ MANIFEST_SUFFIX = ".latest.json"
 _TMP_TAG = ".tmp."
 
 
+class WorldMismatch(RuntimeError):
+    """A snapshot written by a different world (process count / mesh
+    shape) than the one trying to restore it. Deliberately NOT a
+    ValueError: resume_auto treats ValueError as "this snapshot is
+    damaged, try the next one", but a world mismatch damns every
+    snapshot under the prefix equally — falling back (or silently
+    starting fresh) would throw the run's history away. The operator
+    must either relaunch with the matching topology or choose a new
+    snapshot prefix; the message says exactly that."""
+
+
 def manifest_path(prefix):
     return prefix + MANIFEST_SUFFIX
+
+
+def world_signature(solver):
+    """The world a snapshot is only resumable in: the process count and
+    the training mesh's named axis sizes. Stamped into every manifest
+    entry so a relaunch on the wrong topology fails with a sentence,
+    not a cryptic reshape error deep inside restore()."""
+    try:
+        import jax
+        procs = jax.process_count()
+    except Exception:
+        procs = 1
+    sig = {"processes": int(procs)}
+    mesh = getattr(solver, "mesh", None)
+    if mesh is not None and hasattr(mesh, "shape"):
+        try:
+            sig["mesh"] = {str(k): int(v) for k, v in mesh.shape.items()}
+        except Exception:
+            pass
+    return sig
+
+
+def check_world(entry, world, state_path):
+    """Raise WorldMismatch when manifest ``entry`` carries a world
+    stamp that disagrees with ``world`` (the restoring run's
+    world_signature). Entries without a stamp (pre-stamp snapshots)
+    pass through."""
+    want = entry.get("world") if isinstance(entry, dict) else None
+    if not want or not world:
+        return
+    mismatches = []
+    if want.get("processes") is not None and \
+            world.get("processes") is not None and \
+            int(want["processes"]) != int(world["processes"]):
+        mismatches.append(f"process count {want['processes']} vs "
+                          f"{world['processes']}")
+    if want.get("mesh") and world.get("mesh") and \
+            dict(want["mesh"]) != dict(world["mesh"]):
+        mismatches.append(f"mesh {want['mesh']} vs {world['mesh']}")
+    if mismatches:
+        raise WorldMismatch(
+            f"snapshot {state_path} was written by a different world "
+            f"({'; '.join(mismatches)} — snapshot first). Relaunch with "
+            "the topology the snapshot names, or start a new run under "
+            "a different snapshot prefix; refusing to guess.")
 
 
 def _sha256(path, chunk=1 << 20):
@@ -124,6 +180,7 @@ def save_snapshot(solver, prefix, format=None, keep=None, metrics=None):
             "bytes": {"model": os.path.getsize(tmp_model),
                       "state": os.path.getsize(tmp_state)},
             "time": round(time.time(), 3),
+            "world": world_signature(solver),
         }
         os.replace(tmp_model, model_path)
         os.replace(tmp_state, state_path)
@@ -251,12 +308,15 @@ def find_resumable(prefix, log_fn=None, exclude=()):
     return None, skipped
 
 
-def check_restorable(state_path):
+def check_restorable(state_path, world=None):
     """Guard an explicit restore(): if a manifest in the snapshot's
     directory covers this state file, verify the whole pair and raise
     ValueError naming the snapshot and the reason when it fails. Temp
-    files from torn writes are always refused. Un-manifested snapshots
-    pass through (legacy callers)."""
+    files from torn writes are always refused. With ``world`` (the
+    restoring run's world_signature), a stamped snapshot from a
+    different world raises WorldMismatch — the actionable error
+    instead of the cryptic reshape failure a silent restore would
+    produce. Un-manifested snapshots pass through (legacy callers)."""
     if _TMP_TAG in os.path.basename(state_path):
         raise ValueError(f"refusing snapshot {state_path}: temp file from "
                          "an interrupted snapshot write")
@@ -272,6 +332,7 @@ def check_restorable(state_path):
                 if reason is not None:
                     raise ValueError(
                         f"refusing snapshot {state_path}: {reason}")
+                check_world(entry, world, state_path)
                 return
 
 
@@ -310,3 +371,28 @@ def resume_auto(solver, prefix, log_fn=None):
                                iter=solver.iter, state=state,
                                refused=len(skipped) + len(tried))
         return state
+
+
+def wait_for_manifest(prefix, min_iter=None, timeout=120.0, poll=0.2):
+    """Block until the manifest under ``prefix`` records a snapshot at
+    iter >= ``min_iter`` (any snapshot when None); returns the matching
+    entry dict or None on timeout.
+
+    This is the non-writing half of the multi-process snapshot
+    discipline: params/state/history are replicated, so N processes
+    writing the same files would race each other's atomic renames and
+    the manifest commit. Only the designated writer (process 0, or the
+    lowest live host after failures) runs save_snapshot; everyone else
+    barriers here on the manifest the writer committed — the same
+    manifest a coordinated restart later agrees on."""
+    deadline = time.time() + float(timeout)
+    while True:
+        man = load_manifest(prefix)
+        latest = (man or {}).get("latest")
+        if isinstance(latest, dict) and (
+                min_iter is None or
+                int(latest.get("iter", -1)) >= int(min_iter)):
+            return latest
+        if time.time() >= deadline:
+            return None
+        time.sleep(poll)

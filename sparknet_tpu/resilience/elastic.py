@@ -198,10 +198,17 @@ class ElasticPolicy:
     """
 
     def __init__(self, n_workers, quorum=1, evict_after=2, readmit_after=5,
-                 shrink_after=0, metrics=None, log_fn=print, chaos=None):
+                 shrink_after=0, metrics=None, log_fn=print, chaos=None,
+                 unit="worker"):
         self.n = int(n_workers)
+        # membership granularity: "worker" (a mesh slot on the data
+        # axis — PR 4) or "host" (a whole fault domain on the host axis
+        # of the hierarchical runtime). Only labeling and which chaos
+        # injector feeds evictions differ; the masked-consensus math is
+        # identical at either granularity.
+        self.unit = str(unit)
         if self.n < 1:
-            raise ValueError("elastic membership needs >= 1 worker")
+            raise ValueError(f"elastic membership needs >= 1 {self.unit}")
         self.quorum = max(1, int(quorum))
         if self.quorum > self.n:
             raise ValueError(f"quorum {self.quorum} exceeds world size "
@@ -247,7 +254,7 @@ class ElasticPolicy:
 
     def summary(self):
         return {"world": self.n, "live": self.live_count(),
-                "quorum": self.quorum,
+                "quorum": self.quorum, "unit": self.unit,
                 "evictions": list(self.evictions),
                 "readmissions": list(self.readmissions),
                 "quorum_lost": self.quorum_lost}
@@ -263,13 +270,19 @@ class ElasticPolicy:
         self._bad_streak[w] = 0
         self._evicted_at[w] = round_idx
         rec = {"worker": w, "round": round_idx, "reason": reason,
-               "live": self.live_count()}
+               "live": self.live_count(), "unit": self.unit}
         self.evictions.append(rec)
-        self.log(f"elastic: EVICTED worker {w} at round {round_idx} "
+        self.log(f"elastic: EVICTED {self.unit} {w} at round {round_idx} "
                  f"({reason}); {self.live_count()}/{self.n} live, "
                  f"shard re-spread over survivors")
         if self.metrics is not None:
             self.metrics.log("eviction", **rec)
+            if self.unit == "host":
+                # the per-host liveness stream (resilience/heartbeat.py
+                # satellite): monitor/report render host evictions
+                # without reparsing the generic eviction records
+                self.metrics.log("host_evicted", host=w, round=round_idx,
+                                 reason=reason, live=self.live_count())
         return True
 
     def readmit(self, worker, round_idx):
@@ -279,9 +292,10 @@ class ElasticPolicy:
         self.alive[w] = True
         self._bad_streak[w] = 0
         self._evicted_at.pop(w, None)
-        rec = {"worker": w, "round": round_idx, "live": self.live_count()}
+        rec = {"worker": w, "round": round_idx, "live": self.live_count(),
+               "unit": self.unit}
         self.readmissions.append(rec)
-        self.log(f"elastic: readmitted worker {w} at round {round_idx} "
+        self.log(f"elastic: readmitted {self.unit} {w} at round {round_idx} "
                  f"from the consensus weights; "
                  f"{self.live_count()}/{self.n} live")
         if self.metrics is not None:
@@ -297,7 +311,7 @@ class ElasticPolicy:
         self.log(f"elastic: QUORUM LOST at round {round_idx}: "
                  f"{self.live_count()} live, need {self.quorum}")
         raise QuorumLost(
-            f"live workers would drop below quorum {self.quorum} "
+            f"live {self.unit}s would drop below quorum {self.quorum} "
             f"at round {round_idx} (exit {EXIT_QUORUM_LOST})")
 
     # -- the per-round controller ------------------------------------------
@@ -309,8 +323,9 @@ class ElasticPolicy:
         True when membership changed (the caller may want to re-spread
         data or shrink)."""
         changed = False
-        if self.chaos is not None and hasattr(self.chaos, "dead_workers"):
-            for w in self.chaos.dead_workers(round_idx, self.n):
+        injector = "dead_hosts" if self.unit == "host" else "dead_workers"
+        if self.chaos is not None and hasattr(self.chaos, injector):
+            for w in getattr(self.chaos, injector)(round_idx, self.n):
                 changed |= self.evict(w, round_idx, "chaos_kill")
         if valid is not None:
             v = np.asarray(valid, np.float64).ravel()[:self.n]

@@ -1,0 +1,555 @@
+"""Host-level fault domains: leased heartbeats, round rendezvous, and
+coordinated restart over one shared directory.
+
+PR 4's elastic membership generalizes device-worker loss, but the real
+production failure unit is a HOST: preemption, OOM-kill, and network
+partitions take out whole processes. A dead host cannot be detected
+from inside a compiled collective — the collective just hangs — so the
+liveness channel must live entirely on the host side. This module is
+that channel, jax-free so it runs identically on any checkout:
+
+  HeartbeatCoordinator  each process leases its liveness into a shared
+                        rendezvous directory (atomic JSON writes, a
+                        background writer thread), a monitor view marks
+                        peers dead on lease expiry, and the pre-round
+                        ``gate()`` is the no-hang contract: a cross-host
+                        round is dispatched only after every live peer
+                        arrived at the same round index — a dead peer
+                        costs an eviction (via ElasticPolicy, at host
+                        granularity, zero recompiles), never a hang.
+  FileConsensus         the tau-interval cross-host weight average
+                        executed THROUGH the rendezvous directory — the
+                        transport used when the backend has no
+                        cross-host collectives (multi-process CPU), and
+                        a faithful rendering of the paper's own
+                        architecture: SparkNet's driver collected and
+                        re-broadcast weights every tau steps; here the
+                        shared filesystem is the driver, the masked
+                        average is the consensus, and tau amortizes the
+                        slow transport exactly as the paper argues.
+  restart_barrier       coordinated restart: on quorum loss every
+                        surviving process converges on the SAME
+                        checkpoint manifest (barrier on the manifest
+                        file's sha256) before exiting
+                        EXIT_QUORUM_LOST (4), so a supervisor relaunch
+                        resumes one consistent world.
+
+Rendezvous directory layout (one per run, on storage every host
+reaches — NFS/GCS-fuse on fleets, tmp dirs in tests):
+
+  hb-<host>.json        the lease: {host, seq, round, stamp} rewritten
+                        atomically by the writer thread every
+                        ``interval_s`` and at every round arrival
+  part-<host>-<r>.npz   FileConsensus: host's post-round contribution
+  mask-<r>.json         FileConsensus: the round's membership decided
+                        by the lowest-indexed live host
+  restart-<host>.json   restart_barrier: the manifest sha this host
+                        will resume from
+"""
+
+import glob
+import hashlib
+import json
+import os
+import threading
+import time
+
+import numpy as np
+
+
+def _atomic_write_json(path, obj):
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(obj, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def _read_json(path):
+    """Parse a JSON file, or None — a torn write must read as absent,
+    not an error (the writer re-writes within interval_s)."""
+    try:
+        with open(path) as f:
+            obj = json.load(f)
+    except (OSError, ValueError):
+        return None
+    return obj if isinstance(obj, dict) else None
+
+
+class HostDead(RuntimeError):
+    """A peer host's lease expired (reported by gate/exchange)."""
+
+
+class GateResult:
+    """What the pre-round rendezvous saw: which hosts arrived at the
+    round, which leases expired while waiting, and the wait itself —
+    the cross-host round-latency signal the obs layer renders."""
+
+    def __init__(self, arrived, dead, wait_s):
+        self.arrived = sorted(arrived)
+        self.dead = sorted(dead)
+        self.wait_s = float(wait_s)
+
+
+class HeartbeatCoordinator:
+    """One process's end of the liveness protocol.
+
+    Thread contract: a background writer/monitor thread re-leases this
+    host's heartbeat and refreshes the peer view while the training
+    loop reads it; the mutable shared state (seq/round counters, the
+    published liveness view, the stop flag) is guarded by ``_lock``
+    (enforced by `sparknet lint` SPK201/202). Configuration fields
+    (dir/host/n/lease_s/...) are immutable after __init__."""
+
+    def __init__(self, directory, host=None, n_hosts=None, interval_s=0.5,
+                 lease_s=3.0, metrics=None, log_fn=print, chaos=None):
+        if host is None or n_hosts is None:
+            raise ValueError("heartbeat needs host= (this process's id) "
+                             "and n_hosts= (the world size)")
+        self.dir = str(directory)
+        os.makedirs(self.dir, exist_ok=True)
+        self.host = int(host)
+        self.n = int(n_hosts)
+        if not (0 <= self.host < self.n):
+            raise ValueError(f"host {self.host} outside world {self.n}")
+        self.interval_s = float(interval_s)
+        self.lease_s = float(lease_s)
+        if self.lease_s <= self.interval_s:
+            raise ValueError(f"lease_s {self.lease_s} must exceed the "
+                             f"heartbeat interval_s {self.interval_s}")
+        self.metrics = metrics
+        self.log = log_fn or (lambda *a: None)
+        self.chaos = chaos
+        self._lock = threading.Lock()
+        self._seq = 0                                # spk: guarded-by=_lock
+        self._round = -1                             # spk: guarded-by=_lock
+        self._alive_view = np.ones(self.n, bool)     # spk: guarded-by=_lock
+        self._age_view = np.zeros(self.n, np.float64)  # spk: guarded-by=_lock
+        self._ever_dead = set()                      # spk: guarded-by=_lock
+        self._stopped = False                        # spk: guarded-by=_lock
+        self._t0 = time.time()
+        self._stop = threading.Event()
+        self._thread = None
+        if self.chaos is not None and self.n > 1:
+            # real multi-process world: kill_host is rendered by the
+            # TARGET process SIGKILLing itself at the gate
+            # (maybe_kill_self); the virtual dead_hosts injector must
+            # not double-fire on the survivors
+            self.chaos.kill_host_self_mode = True
+
+    # -- the lease ---------------------------------------------------------
+    def _hb_path(self, host):
+        return os.path.join(self.dir, f"hb-{int(host)}.json")
+
+    def beat(self):                          # spk: thread-entry
+        """Re-lease this host's liveness (writer thread + round
+        arrivals both call this). The file write happens UNDER the
+        lock: both threads' temp files share one name (same pid), so
+        two interleaved atomic-rename sequences would race each other's
+        os.replace into FileNotFoundError."""
+        with self._lock:
+            if self._stopped:
+                return
+            self._seq += 1
+            rec = {"host": self.host, "seq": self._seq,
+                   "round": self._round, "stamp": time.time()}
+            _atomic_write_json(self._hb_path(self.host), rec)
+
+    def announce_round(self, round_idx):
+        """Post this host's arrival at ``round_idx`` (the rendezvous
+        half of gate())."""
+        with self._lock:
+            self._round = int(round_idx)
+        self.beat()
+
+    def start(self):
+        """First beat + the background re-leaser. Idempotent."""
+        if self._thread is not None:
+            return self
+        self.beat()
+        self._refresh_view()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name=f"sparknet-hb-{self.host}")
+        self._thread.start()
+        return self
+
+    def _run(self):                          # spk: thread-entry
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.beat()
+                self._refresh_view()
+            except Exception as e:   # liveness must never kill the run
+                self.log(f"heartbeat: writer error: {e!r}")
+
+    def stop(self):
+        """Stop leasing (the host will be seen dead after lease_s).
+        Idempotent; used by tests to simulate a silent host."""
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=2.0)
+            self._thread = None
+        with self._lock:
+            self._stopped = True
+
+    def close(self):
+        self.stop()
+
+    # -- the peer view -----------------------------------------------------
+    def _peer_visible(self, peer, round_idx):
+        """chaos partition_host: a partitioned pair can't see each
+        other's heartbeats (each side independently concludes the other
+        is gone — the classic split-brain the quorum resolves)."""
+        if self.chaos is None or \
+                not hasattr(self.chaos, "host_partitioned"):
+            return True
+        return not self.chaos.host_partitioned(self.host, peer, round_idx)
+
+    def peers(self):
+        """{host: lease record} for every heartbeat file present."""
+        out = {}
+        for p in glob.glob(os.path.join(glob.escape(self.dir), "hb-*.json")):
+            rec = _read_json(p)
+            if rec is not None and isinstance(rec.get("host"), int):
+                out[rec["host"]] = rec
+        return out
+
+    def view(self, now=None):
+        """-> (alive bool (n,), lease_age_s (n,)). A host is alive while
+        its lease is fresh; a host with NO heartbeat yet is granted one
+        lease of startup grace (it may still be initializing), then
+        dead. This host is always alive to itself."""
+        now = time.time() if now is None else now
+        with self._lock:
+            round_idx = self._round
+        peers = self.peers()
+        alive = np.zeros(self.n, bool)
+        age = np.full(self.n, np.inf, np.float64)
+        for h in range(self.n):
+            if h == self.host:
+                alive[h] = True
+                age[h] = 0.0
+                continue
+            rec = peers.get(h) if self._peer_visible(h, round_idx) else None
+            if rec is None:
+                # no heartbeat ever seen: one lease of startup grace
+                # (the peer may still be initializing), then dead
+                if now - self._t0 <= self.lease_s:
+                    alive[h] = True
+                    age[h] = 0.0
+                continue
+            age[h] = max(0.0, now - float(rec.get("stamp", 0.0)))
+            alive[h] = age[h] <= self.lease_s
+        return alive, age
+
+    def _refresh_view(self):                 # spk: thread-entry
+        """Fold the current view into the published one, emitting a
+        ``host_alive`` metrics event per liveness transition (the
+        per-host liveness stream `sparknet monitor`/`report` render)."""
+        alive, age = self.view()
+        with self._lock:
+            prev = self._alive_view
+            self._alive_view = alive
+            self._age_view = age
+            self._ever_dead |= {h for h in range(self.n) if not alive[h]}
+            flips = [h for h in range(self.n) if alive[h] != prev[h]]
+        for h in flips:
+            self.log(f"heartbeat: host {h} is now "
+                     f"{'ALIVE' if alive[h] else 'DEAD'} "
+                     f"(lease age {min(age[h], 1e9):.2f}s / "
+                     f"{self.lease_s}s)")
+            if self.metrics is not None:
+                self.metrics.log("host_alive", host=h, alive=bool(alive[h]),
+                                 lease_age_s=round(float(min(
+                                     age[h], 1e9)), 3),
+                                 observer=self.host)
+
+    def alive_hosts(self):
+        """Host ids currently holding a fresh lease (this host's view)."""
+        alive, _ = self.view()
+        return [h for h in range(self.n) if alive[h]]
+
+    def live_processes(self):
+        return self.alive_hosts()
+
+    def lease_ages(self):
+        _, age = self.view()
+        return [round(float(min(a, 1e9)), 3) for a in age]
+
+    def ever_dead(self):
+        """Hosts whose lease EVER expired this run — after any real
+        peer-process death, the jax.distributed shutdown barrier can
+        never complete, so the CLI must exit without it
+        (parallel.multihost.exit_if_peers_died)."""
+        with self._lock:
+            return set(self._ever_dead)
+
+    # -- the pre-round rendezvous gate -------------------------------------
+    def gate(self, round_idx, expect=None, timeout=None):
+        """Arrive at ``round_idx`` and wait until every expected peer
+        either arrived (its heartbeat shows round >= round_idx) or its
+        lease expired. Never dispatch a cross-host collective before
+        this returns: a dead peer must cost an eviction, not a hang.
+
+        expect: host ids to wait for (default: everyone else). Returns
+        a GateResult; hosts in ``.dead`` should be evicted by the
+        caller's ElasticPolicy (reason "lease_expired")."""
+        if self.chaos is not None:
+            # deterministic host-level injections anchored at the gate:
+            # a killed host dies BEFORE announcing arrival (so peers see
+            # lease expiry, the real crash shape), a slow host arrives
+            # late (the straggler shape)
+            if hasattr(self.chaos, "maybe_kill_self"):
+                self.chaos.maybe_kill_self(self.host, round_idx,
+                                           on_kill=self.stop)
+            if hasattr(self.chaos, "maybe_slow_host"):
+                self.chaos.maybe_slow_host(self.host, round_idx)
+        self.announce_round(round_idx)
+        expect = set(range(self.n)) - {self.host} if expect is None \
+            else {int(h) for h in expect} - {self.host}
+        deadline = None if timeout is None else time.time() + timeout
+        t0 = time.time()
+        arrived, dead = set(), set()
+        while True:
+            now = time.time()
+            alive, age = self.view(now)
+            peers = self.peers()
+            for h in sorted(expect - arrived - dead):
+                rec = peers.get(h) \
+                    if self._peer_visible(h, round_idx) else None
+                if rec is not None and \
+                        int(rec.get("round", -1)) >= round_idx:
+                    arrived.add(h)
+                elif not alive[h]:
+                    dead.add(h)
+            if expect <= arrived | dead:
+                break
+            if deadline is not None and now >= deadline:
+                # an unresponsive-but-leasing host: report as neither
+                # arrived nor dead; the caller decides (straggler alarm)
+                break
+            time.sleep(min(self.interval_s / 4, 0.05))
+        res = GateResult(arrived, dead, time.time() - t0)
+        if dead:
+            with self._lock:
+                self._ever_dead |= dead
+        if self.metrics is not None:
+            self.metrics.log("host_round", round=round_idx,
+                             observer=self.host,
+                             wait_s=round(res.wait_s, 4),
+                             arrived=res.arrived, dead=res.dead,
+                             lease_age_s=self.lease_ages())
+        for h in res.dead:
+            self.log(f"heartbeat: host {h} missed round {round_idx} "
+                     f"(lease expired after {self.lease_s}s)")
+        return res
+
+
+# -- tau-interval consensus over the rendezvous dir -------------------------
+
+class FileConsensus:
+    """Masked cross-host weight averaging through the shared directory.
+
+    The device half of the hierarchy (per-step pmean inside a host)
+    stays a compiled collective; this is the cross-host tier for
+    backends without multi-process collectives. Protocol per round r:
+
+      1. every live host atomically posts part-<host>-<r>.npz: its
+         post-round leaves + {valid, loss} meta
+      2. the LOWEST-indexed live host waits for the others (lease-
+         bounded), then posts mask-<r>.json naming exactly which
+         contributions count — ONE authority per round, so every host
+         computes the identical consensus (the paper's driver, made
+         crash-tolerant: if the authority dies, the next-lowest live
+         host takes over on lease expiry)
+      3. every host averages the masked-in contributions with weight
+         1/n_live and adopts the result — evicted or readmitted hosts
+         included, which makes readmission the same free re-broadcast
+         as the replicated collective path
+
+    All file I/O is atomic-rename; round r's part files are deleted at
+    round r+2 so the directory stays O(hosts) files."""
+
+    def __init__(self, coord, keep_rounds=2):
+        self.coord = coord
+        self.dir = coord.dir
+        self.keep_rounds = max(1, int(keep_rounds))
+
+    def _part_path(self, host, round_idx):
+        return os.path.join(self.dir, f"part-{int(host)}-{int(round_idx)}.npz")
+
+    def _mask_path(self, round_idx):
+        return os.path.join(self.dir, f"mask-{int(round_idx)}.json")
+
+    def _post(self, round_idx, leaves, valid, loss):
+        path = self._part_path(self.coord.host, round_idx)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        meta = json.dumps({"host": self.coord.host, "round": int(round_idx),
+                           "valid": int(bool(valid)),
+                           "loss": float(loss)})
+        with open(tmp, "wb") as f:
+            np.savez(f, meta=np.frombuffer(meta.encode(), np.uint8),
+                     **{f"leaf{i}": np.asarray(a)
+                        for i, a in enumerate(leaves)})
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+
+    def _load(self, host, round_idx, n_leaves):
+        try:
+            with np.load(self._part_path(host, round_idx)) as z:
+                meta = json.loads(bytes(z["meta"]).decode())
+                leaves = [z[f"leaf{i}"] for i in range(n_leaves)]
+        except (OSError, ValueError, KeyError):
+            return None, None
+        return leaves, meta
+
+    def _wait_parts(self, round_idx, hosts, deadline):
+        """Hosts whose contribution for ``round_idx`` landed before
+        ``deadline`` (polling; arrival is the atomic rename)."""
+        got = set()
+        hosts = set(hosts)
+        while True:
+            for h in hosts - got:
+                if os.path.exists(self._part_path(h, round_idx)):
+                    got.add(h)
+            if got >= hosts or time.time() >= deadline:
+                return got
+            time.sleep(min(self.coord.interval_s / 4, 0.05))
+
+    def _decide_mask(self, round_idx, alive, deadline):
+        """The round's membership: written once by the lowest live
+        host, awaited by the rest. If the authority dies before
+        posting, its lease expires, the next-lowest live host becomes
+        the authority and posts instead — one mask per round either
+        way, so every host computes the identical consensus."""
+        me = self.coord.host
+        while True:
+            rec = _read_json(self._mask_path(round_idx))
+            if rec is not None and rec.get("round") == round_idx:
+                return [int(h) for h in rec.get("included", [])]
+            live = set(self.coord.alive_hosts())
+            if min(live | {me}) == me:
+                got = self._wait_parts(round_idx, set(alive) | {me},
+                                       deadline)
+                mask = sorted(got)
+                _atomic_write_json(self._mask_path(round_idx),
+                                   {"round": int(round_idx),
+                                    "included": mask, "authority": me})
+                return mask
+            time.sleep(min(self.coord.interval_s / 4, 0.05))
+
+    def _gc(self, round_idx):
+        for p in glob.glob(os.path.join(glob.escape(self.dir), "part-*.npz")):
+            try:
+                r = int(p.rsplit("-", 1)[1].split(".")[0])
+            except ValueError:
+                continue
+            if r <= round_idx - self.keep_rounds:
+                try:
+                    os.remove(p)
+                except OSError:
+                    pass
+
+    def exchange(self, round_idx, leaves, valid, loss, alive_hosts,
+                 timeout=None):
+        """One cross-host averaging round. ``leaves``: this host's flat
+        list of numpy arrays (params+state in tree order); ``valid``:
+        this host's finite bit; ``alive_hosts``: the membership in
+        force (ElasticPolicy.live()). Returns (consensus_leaves, aux)
+        where aux mirrors the compiled masked_consensus_stats membership
+        report: valid (n,), n_live, worker_loss (n,), div_worker_sq
+        (n,) — so the divergence/health/monitor pipeline runs unchanged
+        over the relay transport."""
+        n = self.coord.n
+        timeout = self.coord.lease_s if timeout is None else timeout
+        self._post(round_idx, leaves, valid, loss)
+        deadline = time.time() + timeout
+        included = self._decide_mask(round_idx, set(alive_hosts), deadline)
+        parts, metas = {}, {}
+        for h in included:
+            lv, meta = self._load(h, round_idx, len(leaves))
+            if lv is not None and meta.get("valid"):
+                parts[h], metas[h] = lv, meta
+        if not parts:
+            # no valid contribution anywhere (every live host NaN'd):
+            # keep our own leaves; the policy will see the all-invalid
+            # vector and act (evict/quorum)
+            parts = {self.coord.host: leaves}
+            metas = {self.coord.host: {"loss": float(loss),
+                                       "valid": int(bool(valid))}}
+        w = 1.0 / len(parts)
+        consensus = []
+        for i in range(len(leaves)):
+            acc = None
+            for h in parts:
+                x = np.asarray(parts[h][i], np.float64)
+                acc = x * w if acc is None else acc + x * w
+            consensus.append(acc.astype(np.asarray(leaves[i]).dtype))
+        valid_vec = np.zeros(n, np.float32)
+        loss_vec = np.full(n, np.nan, np.float32)
+        div_sq = np.zeros(n, np.float32)
+        for h in parts:
+            valid_vec[h] = 1.0
+            loss_vec[h] = metas[h].get("loss", float("nan"))
+            div_sq[h] = sum(
+                float(((np.asarray(parts[h][i], np.float64)
+                        - np.asarray(consensus[i], np.float64)) ** 2).sum())
+                for i in range(len(leaves)))
+        live_div = div_sq[valid_vec > 0]
+        aux = {"valid": valid_vec, "n_live": np.float32(len(parts)),
+               "worker_loss": loss_vec, "div_worker_sq": div_sq,
+               "div_mean_sq": np.float32(live_div.mean()),
+               "div_max_sq": np.float32(live_div.max()),
+               "transport": "relay"}
+        self._gc(round_idx)
+        return consensus, aux
+
+
+# -- coordinated restart -----------------------------------------------------
+
+def manifest_sha(prefix):
+    """sha256 of the checkpoint manifest file itself — the value every
+    survivor must agree on before a coordinated exit."""
+    from .checkpoint import manifest_path
+    try:
+        with open(manifest_path(prefix), "rb") as f:
+            return hashlib.sha256(f.read()).hexdigest()
+    except OSError:
+        return None
+
+
+def restart_barrier(coord, sha, timeout=30.0):
+    """Post this host's resume manifest sha and wait for every LIVE
+    peer to post theirs. Returns (agreed, shas_by_host). Used on quorum
+    loss so all survivors exit 4 holding the SAME resumable manifest —
+    the supervisor relaunch then resumes one consistent world."""
+    path = os.path.join(coord.dir, f"restart-{coord.host}.json")
+    _atomic_write_json(path, {"host": coord.host, "sha": sha,
+                              "stamp": time.time()})
+    deadline = time.time() + timeout
+    while True:
+        live = coord.alive_hosts()
+        shas = {}
+        for h in live:
+            rec = _read_json(os.path.join(coord.dir, f"restart-{h}.json"))
+            if rec is not None:
+                shas[h] = rec.get("sha")
+        if set(live) <= set(shas) or time.time() >= deadline:
+            agreed = len(set(shas.values())) == 1 and \
+                set(live) <= set(shas)
+            if coord.metrics is not None:
+                coord.metrics.log("membership", kind="coordinated_restart",
+                                  observer=coord.host, agreed=agreed,
+                                  sha=sha, hosts=sorted(shas))
+            if not agreed:
+                coord.log(f"coordinated restart: survivors did NOT "
+                          f"converge on one manifest: {shas}")
+            else:
+                coord.log("coordinated restart: all "
+                          f"{len(shas)} survivor(s) agree on manifest "
+                          f"{str(sha)[:12]}… — exiting for supervisor "
+                          "relaunch")
+            return agreed, shas
+        time.sleep(min(coord.interval_s / 2, 0.1))

@@ -111,6 +111,10 @@ class Solver:
         self.snapshot_keep = None
         self.recovery = None
         self.elastic = None
+        # host-level fault domains (resilience/heartbeat.py), armed via
+        # arm_heartbeat(): leased liveness for every peer process, the
+        # pre-round rendezvous gate, and the coordinated-restart barrier
+        self.heartbeat = None
         from ..resilience.chaos import active_chaos
         self.chaos = active_chaos()
         train_np, test_np = resolve_nets(solver_param, base_dir, net_param)
@@ -376,16 +380,25 @@ class Solver:
         masked quorum averages, sick workers are evicted/readmitted,
         and dropping below ``quorum`` raises QuorumLost (exit 4). Only
         sharded solvers (a data-axis mesh) act on it; arming rebuilds
-        the compiled step/round so the membership aux is traced in."""
+        the compiled step/round so the membership aux is traced in.
+
+        Hierarchical solvers (a host axis — parallel.multihost) declare
+        elastic_axis/elastic_unit, so membership runs at HOST
+        granularity; with the heartbeat relay armed the world spans the
+        jax processes rather than the local mesh."""
         mesh = getattr(self, "mesh", None)
-        axis = getattr(self, "axis", None)
+        axis = getattr(self, "elastic_axis", None) or \
+            getattr(self, "axis", None)
         n = mesh.shape[axis] if mesh is not None and axis in mesh.shape \
             else 1
+        if getattr(self, "_relay", None) is not None:
+            n = self.heartbeat.n
         if policy is None:
             from ..resilience.elastic import ElasticPolicy
             kw.setdefault("metrics", self.metrics)
             kw.setdefault("log_fn", self.log)
             kw.setdefault("chaos", self.chaos)
+            kw.setdefault("unit", getattr(self, "elastic_unit", "worker"))
             policy = ElasticPolicy(n_workers=n, **kw)
         self.elastic = policy
         self._jit_train = None
@@ -393,12 +406,69 @@ class Solver:
             self._jit_round = None
         return policy
 
+    def arm_heartbeat(self, directory, interval_s=0.5, lease_s=3.0,
+                      relay="auto", **kw):
+        """Arm host-level fault domains (resilience/heartbeat.py): this
+        process leases its liveness into ``directory`` (shared storage
+        every host reaches), a monitor thread marks peer hosts dead on
+        lease expiry, and sharded solvers gate every cross-host round
+        on the rendezvous so a dead peer costs an eviction, never a
+        hang inside a collective.
+
+        relay: "auto" routes the tau-interval cross-host average
+        through the directory (heartbeat.FileConsensus) when the
+        backend has no multi-process collectives (multi-process CPU);
+        True/False force it. Arm BEFORE arm_elastic so the membership
+        world sizes to the process count."""
+        from ..resilience.heartbeat import (HeartbeatCoordinator,
+                                            FileConsensus)
+        host = jax.process_index()
+        n = jax.process_count()
+        kw.setdefault("metrics", self.metrics)
+        kw.setdefault("log_fn", self.log)
+        kw.setdefault("chaos", self.chaos)
+        coord = HeartbeatCoordinator(directory, host=host, n_hosts=n,
+                                     interval_s=interval_s,
+                                     lease_s=lease_s, **kw).start()
+        self.heartbeat = coord
+        if relay == "auto":
+            from ..parallel.multihost import needs_host_relay
+            relay = needs_host_relay()
+        if relay and hasattr(self, "_train_round_relay"):
+            self._relay = FileConsensus(coord)
+            self.log(f"heartbeat: relay consensus armed ({n} hosts "
+                     "through the rendezvous directory)")
+        if self.elastic is not None and self.elastic.n != n and \
+                getattr(self, "_relay", None) is not None:
+            self.log(f"heartbeat: WARNING — elastic world {self.elastic.n}"
+                     f" != {n} processes; arm_heartbeat before "
+                     "arm_elastic in relay mode")
+        return coord
+
+    def coordinated_restart(self, prefix, timeout=30.0):
+        """Quorum loss in a multi-host world: barrier with every
+        surviving process on the sha256 of the snapshot manifest under
+        ``prefix`` before exiting 4, so a supervisor restart resumes
+        ONE consistent world (resilience/heartbeat.restart_barrier).
+        Single-process (or heartbeat-less) runs: a no-op True."""
+        if self.heartbeat is None or jax.process_count() <= 1:
+            return True
+        from ..resilience.heartbeat import manifest_sha, restart_barrier
+        sha = manifest_sha(prefix)
+        agreed, _ = restart_barrier(self.heartbeat, sha, timeout=timeout)
+        return agreed
+
     def _alive_mask(self):
         """The (n,) f32 alive mask the compiled step/round consumes —
         all ones without elastic membership, which keeps the masked
-        average bit-for-bit the plain pmean."""
-        n = self.mesh.shape[self.axis]
-        if self.elastic is not None:
+        average bit-for-bit the plain pmean. Sized to the mesh's
+        membership axis (the host axis of hierarchical solvers); under
+        the relay transport the policy world spans PROCESSES instead,
+        so the local compiled round sees all-ones and membership is
+        applied host-side at the exchange."""
+        axis = getattr(self, "elastic_axis", None) or self.axis
+        n = self.mesh.shape[axis]
+        if self.elastic is not None and self.elastic.n == n:
             return jnp.asarray(self.elastic.alive_f32())
         return jnp.ones((n,), jnp.float32)
 
@@ -557,6 +627,13 @@ class Solver:
         if self.watchdog is not None:
             self.watchdog.stop()
             self.watchdog = None
+        if self.heartbeat is not None:
+            try:
+                self.heartbeat.stop()   # the leaser thread must not
+            finally:                    # outlive the run (pytest hangs)
+                self.heartbeat = None
+                if getattr(self, "_relay", None) is not None:
+                    self._relay = None
         if self.health is not None:
             try:
                 if self.health.alarms and self.metrics is not None:
@@ -813,12 +890,45 @@ class Solver:
                     array_to_blob(np.asarray(self.history[lname][i][s])))
             wire.dump(ss, state_path)
 
+    def _snapshot_writer(self):
+        """Which process commits snapshots in a multi-process world:
+        the lowest-indexed LIVE host (process 0 while healthy). Params/
+        state/history are replicated, so N processes writing the same
+        files would race each other's renames and manifest commits —
+        the bug class the multi-process SIGTERM path used to have."""
+        if jax.process_count() <= 1:
+            return True
+        me = jax.process_index()
+        hb = self.heartbeat
+        if hb is not None:
+            try:
+                return me == min(hb.live_processes() + [me])
+            except Exception:
+                pass
+        return me == 0
+
     def _snapshot(self, prefix=None, format=None):
         # every snapshot goes through the crash-safe commit protocol:
         # temp-write -> fsync -> atomic rename -> manifest (the manifest
-        # covers model+state as ONE unit; see resilience/checkpoint.py)
+        # covers model+state as ONE unit; see resilience/checkpoint.py).
+        # Multi-process: the designated writer commits; everyone else
+        # barriers on the manifest it produced (satellite: N processes
+        # must never race the same snapshot files).
         from ..resilience import checkpoint
         prefix = prefix or self.param.snapshot_prefix
+        if not self._snapshot_writer():
+            entry = checkpoint.wait_for_manifest(prefix,
+                                                 min_iter=self.iter)
+            if entry is None:
+                self.log(f"snapshot: writer never committed iter "
+                         f"{self.iter} under {prefix!r} (timed out); "
+                         "continuing without a local copy")
+                return None, None
+            d = os.path.dirname(prefix)
+            self.log(f"snapshot: committed by the writer process "
+                     f"(iter {entry.get('iter')})")
+            return (os.path.join(d, entry.get("model", "")),
+                    os.path.join(d, entry.get("state", "")))
         model_path, state_path = checkpoint.save_snapshot(
             self, prefix, format=format, keep=self.snapshot_keep,
             metrics=self.metrics)
@@ -828,10 +938,13 @@ class Solver:
     def restore(self, state_path):
         """Resume from a .solverstate[.h5] (+ its learned_net weights).
         Snapshots a manifest marks partial/corrupt are refused with the
-        reason (resilience/checkpoint.py)."""
+        reason; a snapshot stamped by a DIFFERENT world (process count
+        or mesh shape) raises WorldMismatch with the remedy
+        (resilience/checkpoint.py)."""
         from . import hdf5_io
         from ..resilience import checkpoint
-        checkpoint.check_restorable(state_path)
+        checkpoint.check_restorable(
+            state_path, world=checkpoint.world_signature(self))
         self._it_dev = None          # re-seed the device iter counter
         if state_path.endswith(".h5"):
             it, learned, self.history = hdf5_io.load_state_hdf5(

@@ -9,6 +9,15 @@ Beyond the reference: SIGTERM — the preemption notice every scheduler
 (k8s, borg, spot VMs) sends before a kill — maps to "snapshot_stop"
 (snapshot, then stop cleanly), so a preempted job loses at most the
 steps since its last sync round and `--resume auto` picks it back up.
+
+Multi-process discipline: a scheduler delivers the SIGTERM to EVERY
+process of the job, and each polls its own handler — but N processes
+must not race N writes of the same (replicated) snapshot. The snapshot
+the handlers trigger goes through Solver._snapshot, where only the
+designated writer (process 0, or the lowest live host once failures
+start) commits; the others barrier on the manifest it produced
+(resilience/checkpoint.wait_for_manifest) and then stop with the same
+documented exit code 0. See the DEPLOY.md preemption runbook.
 """
 
 import signal

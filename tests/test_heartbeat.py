@@ -1,0 +1,429 @@
+"""Host-level fault domains (resilience/heartbeat.py) + the hierarchical
+runtime's host-side pieces, exercised single-process: leased heartbeats,
+lease-expiry death, the round gate, FileConsensus masked averaging with
+authority failover, the coordinated-restart barrier, host-granularity
+chaos injectors, and the checkpoint world-mismatch guard."""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from conftest import REFERENCE  # noqa: F401  (conftest sets the cpu env)
+
+from sparknet_tpu.resilience.heartbeat import (
+    HeartbeatCoordinator, FileConsensus, manifest_sha, restart_barrier)
+from sparknet_tpu.resilience.chaos import ChaosMonkey
+from sparknet_tpu.resilience import checkpoint
+from sparknet_tpu.resilience.elastic import ElasticPolicy, QuorumLost
+
+
+class _Sink:
+    def __init__(self):
+        self.events = []
+
+    def log(self, event, **fields):
+        self.events.append(dict(fields, event=event))
+
+    def kinds(self):
+        return [e["event"] for e in self.events]
+
+
+def _coord(tmp_path, host, n, interval=0.05, lease=0.4, **kw):
+    return HeartbeatCoordinator(str(tmp_path), host=host, n_hosts=n,
+                                interval_s=interval, lease_s=lease,
+                                log_fn=lambda *a: None, **kw)
+
+
+# --------------------------------------------------------------- leases ----
+class TestLeases:
+    def test_beat_writes_lease_and_peer_sees_alive(self, tmp_path):
+        a = _coord(tmp_path, 0, 2).start()
+        b = _coord(tmp_path, 1, 2).start()
+        try:
+            alive, age = a.view()
+            assert list(alive) == [True, True]
+            assert age[1] < 0.4
+        finally:
+            a.stop()
+            b.stop()
+
+    def test_lease_expiry_marks_host_dead(self, tmp_path):
+        sink = _Sink()
+        a = _coord(tmp_path, 0, 2, metrics=sink).start()
+        b = _coord(tmp_path, 1, 2).start()
+        try:
+            b.stop()                     # host 1 goes silent
+            deadline = time.time() + 5
+            while time.time() < deadline:
+                alive, _ = a.view()
+                if not alive[1]:
+                    break
+                time.sleep(0.05)
+            alive, age = a.view()
+            assert not alive[1] and age[1] > a.lease_s
+            # self is always alive to itself
+            assert alive[0]
+        finally:
+            a.stop()
+
+    def test_host_alive_transition_event_emitted(self, tmp_path):
+        sink = _Sink()
+        a = _coord(tmp_path, 0, 2, metrics=sink).start()
+        b = _coord(tmp_path, 1, 2).start()
+        b.stop()
+        try:
+            deadline = time.time() + 5
+            while time.time() < deadline:
+                if any(e["event"] == "host_alive" and not e["alive"]
+                       for e in sink.events):
+                    break
+                time.sleep(0.05)
+            ev = [e for e in sink.events
+                  if e["event"] == "host_alive" and e["host"] == 1]
+            assert ev and ev[-1]["alive"] is False
+            assert ev[-1]["lease_age_s"] > a.lease_s
+        finally:
+            a.stop()
+
+    def test_startup_grace_then_dead(self, tmp_path):
+        # peer never starts: alive through one lease of grace, then dead
+        a = _coord(tmp_path, 0, 2).start()
+        try:
+            alive, _ = a.view()
+            assert alive[1], "startup grace should cover a late joiner"
+            time.sleep(a.lease_s + 0.2)
+            alive, _ = a.view()
+            assert not alive[1]
+        finally:
+            a.stop()
+
+    def test_bad_lease_config_rejected(self, tmp_path):
+        with pytest.raises(ValueError, match="lease_s"):
+            _coord(tmp_path, 0, 2, interval=1.0, lease=0.5)
+        with pytest.raises(ValueError, match="world"):
+            _coord(tmp_path, 5, 2)
+
+
+# ----------------------------------------------------------------- gate ----
+class TestGate:
+    def test_gate_passes_when_all_arrive(self, tmp_path):
+        a = _coord(tmp_path, 0, 2).start()
+        b = _coord(tmp_path, 1, 2).start()
+        try:
+            b.announce_round(3)
+            res = a.gate(3)
+            assert res.arrived == [1] and res.dead == []
+        finally:
+            a.stop()
+            b.stop()
+
+    def test_gate_reports_dead_peer_not_hang(self, tmp_path):
+        a = _coord(tmp_path, 0, 2).start()
+        b = _coord(tmp_path, 1, 2).start()
+        try:
+            b.announce_round(0)
+            a.gate(0)
+            b.stop()                     # dies between rounds
+            t0 = time.time()
+            res = a.gate(1)
+            assert res.dead == [1] and res.arrived == []
+            # bounded by the lease, not a hang
+            assert time.time() - t0 < a.lease_s + 3
+        finally:
+            a.stop()
+
+    def test_gate_emits_host_round_event(self, tmp_path):
+        sink = _Sink()
+        a = _coord(tmp_path, 0, 1, metrics=sink).start()
+        try:
+            a.gate(0)
+            ev = [e for e in sink.events if e["event"] == "host_round"]
+            assert ev and ev[0]["round"] == 0
+            assert "wait_s" in ev[0] and "lease_age_s" in ev[0]
+        finally:
+            a.stop()
+
+
+# -------------------------------------------------------- file consensus ----
+class TestFileConsensus:
+    def test_two_host_masked_average(self, tmp_path):
+        a = _coord(tmp_path, 0, 2).start()
+        b = _coord(tmp_path, 1, 2).start()
+        try:
+            fa, fb = FileConsensus(a), FileConsensus(b)
+            la = [np.ones((2, 2), np.float32), np.float32(2.0)]
+            lb = [np.full((2, 2), 3.0, np.float32), np.float32(4.0)]
+            # post b's part first, then run a's exchange (a is the
+            # authority and will find both parts present)
+            fb._post(0, lb, True, 1.0)
+            out, aux = fa.exchange(0, la, True, 0.5, [0, 1])
+            np.testing.assert_allclose(out[0], np.full((2, 2), 2.0))
+            np.testing.assert_allclose(out[1], 3.0)
+            assert list(aux["valid"]) == [1.0, 1.0]
+            assert float(aux["n_live"]) == 2
+            np.testing.assert_allclose(aux["worker_loss"], [0.5, 1.0])
+            # b computes the IDENTICAL consensus from the same mask file
+            out_b, aux_b = fb.exchange(0, lb, True, 1.0, [0, 1])
+            np.testing.assert_array_equal(out[0], out_b[0])
+        finally:
+            a.stop()
+            b.stop()
+
+    def test_missing_host_masked_out(self, tmp_path):
+        a = _coord(tmp_path, 0, 2, lease=0.3).start()
+        try:
+            fa = FileConsensus(a)
+            la = [np.full((2,), 6.0, np.float32)]
+            out, aux = fa.exchange(0, la, True, 0.1, [0, 1], timeout=0.4)
+            # host 1 never contributed: consensus is host 0's leaves
+            np.testing.assert_allclose(out[0], la[0])
+            assert list(aux["valid"]) == [1.0, 0.0]
+            assert float(aux["n_live"]) == 1
+        finally:
+            a.stop()
+
+    def test_invalid_contribution_excluded(self, tmp_path):
+        a = _coord(tmp_path, 0, 2).start()
+        b = _coord(tmp_path, 1, 2).start()
+        try:
+            fa = FileConsensus(a)
+            nan = [np.full((2,), np.nan, np.float32)]
+            FileConsensus(b)._post(0, nan, False, float("nan"))
+            out, aux = fa.exchange(0, [np.ones(2, np.float32)], True,
+                                   0.2, [0, 1])
+            assert np.isfinite(out[0]).all(), \
+                "a NaN'd host poisoned the relay consensus"
+            assert list(aux["valid"]) == [1.0, 0.0]
+        finally:
+            a.stop()
+
+    def test_divergence_aux_matches_hand_computation(self, tmp_path):
+        a = _coord(tmp_path, 0, 2).start()
+        b = _coord(tmp_path, 1, 2).start()
+        try:
+            fa = FileConsensus(a)
+            la = [np.zeros(4, np.float32)]
+            lb = [np.full(4, 2.0, np.float32)]
+            FileConsensus(b)._post(0, lb, True, 0.0)
+            out, aux = fa.exchange(0, la, True, 0.0, [0, 1])
+            # consensus = 1.0; each host's sq dist = 4 * 1^2 = 4
+            np.testing.assert_allclose(aux["div_worker_sq"], [4.0, 4.0])
+            np.testing.assert_allclose(aux["div_mean_sq"], 4.0)
+        finally:
+            a.stop()
+            b.stop()
+
+    def test_part_files_garbage_collected(self, tmp_path):
+        a = _coord(tmp_path, 0, 1).start()
+        try:
+            fa = FileConsensus(a)
+            for r in range(4):
+                fa.exchange(r, [np.ones(2, np.float32)], True, 0.0, [0])
+            import glob
+            left = glob.glob(os.path.join(str(tmp_path), "part-*.npz"))
+            rounds = sorted(int(p.rsplit("-", 1)[1].split(".")[0])
+                            for p in left)
+            assert rounds == [2, 3], rounds
+        finally:
+            a.stop()
+
+
+# ---------------------------------------------------- coordinated restart ----
+class TestCoordinatedRestart:
+    def test_barrier_agreement(self, tmp_path):
+        import threading
+        sink = _Sink()
+        a = _coord(tmp_path, 0, 2, metrics=sink).start()
+        b = _coord(tmp_path, 1, 2).start()
+        try:
+            out = {}
+
+            def side_b():
+                out["b"] = restart_barrier(b, "abc123", timeout=10)
+            t = threading.Thread(target=side_b)
+            t.start()
+            agreed_a, shas = restart_barrier(a, "abc123", timeout=10)
+            t.join(timeout=15)
+            assert agreed_a and out["b"][0]
+            assert shas == {0: "abc123", 1: "abc123"}
+            ev = [e for e in sink.events
+                  if e.get("kind") == "coordinated_restart"]
+            assert ev and ev[0]["agreed"]
+        finally:
+            a.stop()
+            b.stop()
+
+    def test_barrier_disagreement_reported(self, tmp_path):
+        a = _coord(tmp_path, 0, 2).start()
+        b = _coord(tmp_path, 1, 2).start()
+        try:
+            restart_barrier(b, "zzz", timeout=0.2)   # post, don't wait
+            agreed, shas = restart_barrier(a, "abc", timeout=10)
+            assert not agreed
+            assert shas[0] != shas[1]
+        finally:
+            a.stop()
+            b.stop()
+
+    def test_manifest_sha_roundtrip(self, tmp_path):
+        prefix = str(tmp_path / "snap")
+        assert manifest_sha(prefix) is None
+        with open(checkpoint.manifest_path(prefix), "w") as f:
+            json.dump({"version": 1}, f)
+        sha = manifest_sha(prefix)
+        assert isinstance(sha, str) and len(sha) == 64
+
+
+# ------------------------------------------------------------ host chaos ----
+class TestHostChaos:
+    def test_kill_host_virtual_feeds_policy(self):
+        ch = ChaosMonkey.parse("kill_host=2,kill_host_round=3")
+        assert ch.dead_hosts(2, 4) == []
+        assert ch.dead_hosts(3, 4) == [2]
+        assert ch.dead_hosts(4, 4) == []          # fires once
+
+    def test_kill_host_self_mode_suppresses_virtual(self):
+        ch = ChaosMonkey.parse("kill_host=1")
+        ch.kill_host_self_mode = True
+        assert ch.dead_hosts(0, 4) == []
+
+    def test_maybe_kill_self_only_targets_the_named_host(self):
+        ch = ChaosMonkey.parse("kill_host=1,kill_host_round=2")
+        # wrong host / too early: no kill (we're alive to assert it)
+        assert ch.maybe_kill_self(0, 5) is False
+        assert ch.maybe_kill_self(1, 1) is False
+
+    def test_partition_host_cuts_both_directions(self):
+        ch = ChaosMonkey.parse("partition_host=1,partition_round=2")
+        assert not ch.host_partitioned(0, 1, 1)
+        assert ch.host_partitioned(0, 1, 2)
+        assert ch.host_partitioned(1, 0, 2)
+        assert not ch.host_partitioned(0, 2, 2)
+        assert not ch.host_partitioned(1, 1, 2)
+
+    def test_partitioned_peer_appears_dead(self, tmp_path):
+        ch = ChaosMonkey.parse("partition_host=1,partition_round=0")
+        a = _coord(tmp_path, 0, 2, chaos=ch).start()
+        b = _coord(tmp_path, 1, 2).start()
+        try:
+            a.announce_round(0)
+            time.sleep(a.lease_s + 0.2)   # outlive the startup grace
+            alive, _ = a.view()
+            assert not alive[1], "partitioned peer must appear dead"
+        finally:
+            a.stop()
+            b.stop()
+
+    def test_slow_host_sleeps_and_attributes(self):
+        ch = ChaosMonkey.parse("slow_host=1,slow_host_s=0.2")
+        t0 = time.time()
+        assert ch.maybe_slow_host(0, 0) == 0.0
+        sec = ch.maybe_slow_host(1, 0)
+        assert sec == pytest.approx(0.2)
+        assert time.time() - t0 >= 0.2
+        assert ch.pop_slow_host() == (1, 0.2)
+        assert ch.pop_slow_host() is None
+        assert ch.maybe_slow_host(1, 1) == 0.0    # fires once
+
+    def test_unknown_chaos_keys_still_rejected(self):
+        with pytest.raises(ValueError, match="unknown chaos keys"):
+            ChaosMonkey.parse("kill_hosts=1")
+
+
+# ------------------------------------------------- world-mismatch guard ----
+def _mini_solver(mesh=None, host_axis=None):
+    import jax
+    from sparknet_tpu.proto import Message
+    from sparknet_tpu.models import zoo
+    from sparknet_tpu.parallel import LocalSGDSolver, make_mesh
+    sp = Message("SolverParameter", base_lr=0.01, lr_policy="fixed",
+                 momentum=0.9, display=0, random_seed=0)
+    return LocalSGDSolver(
+        sp, mesh=mesh if mesh is not None else make_mesh({"data": 8}),
+        tau=1, host_axis=host_axis, net_param=zoo.lenet(batch_size=2),
+        log_fn=lambda *a: None)
+
+
+class TestWorldMismatch:
+    def test_world_stamp_in_manifest(self, tmp_path):
+        s = _mini_solver()
+        prefix = str(tmp_path / "snap")
+        s.snapshot(prefix=prefix)
+        man = checkpoint.load_manifest(prefix)
+        w = man["latest"]["world"]
+        assert w["processes"] == 1
+        assert w["mesh"] == {"data": 8}
+
+    def test_restore_refuses_wrong_world(self, tmp_path):
+        from sparknet_tpu.parallel import make_host_device_mesh
+        s = _mini_solver()
+        prefix = str(tmp_path / "snap")
+        _, state = s.snapshot(prefix=prefix)
+        other = _mini_solver(
+            mesh=make_host_device_mesh(hosts=2, per_host=4),
+            host_axis="host")
+        with pytest.raises(checkpoint.WorldMismatch,
+                           match="different world"):
+            other.restore(state)
+        # the message is actionable: names both worlds + the remedy
+        try:
+            other.restore(state)
+        except checkpoint.WorldMismatch as e:
+            msg = str(e)
+            assert "mesh" in msg and "Relaunch" in msg
+
+    def test_resume_auto_propagates_world_mismatch(self, tmp_path):
+        from sparknet_tpu.parallel import make_host_device_mesh
+        s = _mini_solver()
+        prefix = str(tmp_path / "snap")
+        s.snapshot(prefix=prefix)
+        other = _mini_solver(
+            mesh=make_host_device_mesh(hosts=2, per_host=4),
+            host_axis="host")
+        # NOT silently skipped-and-started-fresh: the operator must act
+        with pytest.raises(checkpoint.WorldMismatch):
+            checkpoint.resume_auto(other, prefix, log_fn=lambda *a: None)
+
+    def test_same_world_restores(self, tmp_path):
+        s = _mini_solver()
+        prefix = str(tmp_path / "snap")
+        _, state = s.snapshot(prefix=prefix)
+        twin = _mini_solver()
+        twin.restore(state)              # no raise
+        assert twin.iter == s.iter
+
+    def test_unstamped_legacy_entry_passes(self, tmp_path):
+        s = _mini_solver()
+        prefix = str(tmp_path / "snap")
+        _, state = s.snapshot(prefix=prefix)
+        man = checkpoint.load_manifest(prefix)
+        for e in man["snapshots"]:
+            e.pop("world", None)
+        man["latest"].pop("world", None)
+        checkpoint._atomic_write_json(checkpoint.manifest_path(prefix), man)
+        twin = _mini_solver()
+        twin.restore(state)              # pre-stamp snapshots still load
+
+
+# ------------------------------------- policy wiring at host granularity ----
+class TestHostPolicy:
+    def test_lease_expired_eviction_reason(self, tmp_path):
+        sink = _Sink()
+        p = ElasticPolicy(n_workers=3, quorum=1, unit="host",
+                          metrics=sink, log_fn=lambda *a: None)
+        p.evict(2, 5, "lease_expired")
+        assert p.live() == [0, 1]
+        ev = [e for e in sink.events if e["event"] == "eviction"]
+        assert ev[0]["unit"] == "host"
+        assert ev[0]["reason"] == "lease_expired"
+        he = [e for e in sink.events if e["event"] == "host_evicted"]
+        assert he and he[0]["host"] == 2
+
+    def test_quorum_names_hosts(self):
+        p = ElasticPolicy(n_workers=2, quorum=2, unit="host",
+                          log_fn=lambda *a: None)
+        with pytest.raises(QuorumLost, match="hosts"):
+            p.evict(0, 1, "lease_expired")
