@@ -19,6 +19,13 @@ echo "sparknet lint OK"
 bash scripts/smoke.sh multihost || exit 1
 echo "multihost smoke OK"
 
+# async bounded staleness, end to end: the chaos slow-worker run must
+# finish under a wall-clock budget the synchronous barrier cannot meet,
+# with the straggler parked+readmitted and the staleness section in
+# `sparknet report` (scripts/smoke.sh stage h)
+bash scripts/smoke.sh async || exit 1
+echo "async smoke OK"
+
 set -o pipefail
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu \

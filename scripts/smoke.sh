@@ -19,9 +19,16 @@
 #       host mid-run, the survivor evicts it on lease expiry, completes
 #       every round, exits 0, and `sparknet report` shows the host
 #       eviction + fault-domain section.
+# Async bounded staleness (ISSUE 7):
+#   (h) the same chaos slow_worker run twice: the SYNCHRONOUS barrier
+#       waits out the straggler's injected stall every round, while the
+#       async `--staleness` run must finish under a wall-clock budget
+#       the synchronous mode cannot meet (its injected stall alone
+#       exceeds the gap), with the straggler parked+readmitted and the
+#       staleness section rendered by `sparknet report`.
 #
-# Usage: smoke.sh [all|multihost]  — `multihost` runs only stage (g)
-# (the fast CI wiring; scripts/ci.sh invokes it).
+# Usage: smoke.sh [all|multihost|async]  — `multihost`/`async` run only
+# that stage (the fast CI wiring; scripts/ci.sh invokes both).
 set -euo pipefail
 cd "$(dirname "$0")/.."
 export JAX_PLATFORMS=cpu
@@ -73,9 +80,88 @@ s.bind(('localhost',0)); print(s.getsockname()[1])")
          "survivor completed and exited 0"
 }
 
+# ------------------------------------------ async bounded staleness ----
+# The acceptance demonstration: a chaos slow_worker (2 s extra per round,
+# every round) under the SYNCHRONOUS barrier stalls every round — 6
+# rounds pay >= 12 s of pure injected stall. The async --staleness run
+# of the SAME workload never waits for the straggler (its seconds land
+# on its virtual version clock), so it must beat the synchronous wall
+# clock by most of that stall; the straggler must be parked and
+# readmitted with membership events, and `sparknet report` must render
+# the staleness section.
+run_async_stage() {
+    as="$tmp/async"
+    mkdir -p "$as"
+    t0=$SECONDS
+    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python -m sparknet_tpu cifar --workers 4 --tau 2 --rounds 6 \
+        --test-every 100 --metrics "$as/sync.jsonl" \
+        --chaos "slow_worker=1,slow_s=2" --quorum 1 \
+        > "$as/sync.out" 2>&1
+    sync_s=$((SECONDS - t0))
+    test "$sync_s" -ge 12 || { echo "sync baseline did not stall on the"\
+                                    "straggler (${sync_s}s)"; exit 1; }
+    t0=$SECONDS
+    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python -m sparknet_tpu cifar --workers 4 --tau 2 --rounds 6 \
+        --test-every 100 --metrics "$as/async.jsonl" \
+        --chaos "slow_worker=1,slow_s=2" --staleness 1 \
+        --health-cooldown 1 > "$as/async.out" 2>&1
+    async_s=$((SECONDS - t0))
+    # the budget the synchronous mode cannot meet: its injected stall
+    # alone (12 s) exceeds the allowed gap to its own wall clock
+    budget=$((sync_s - 6))
+    test "$async_s" -lt "$budget" || {
+        echo "async run did not beat the barrier: ${async_s}s vs" \
+             "sync ${sync_s}s (budget ${budget}s)"; exit 1; }
+    grep -q "PARKED worker 1" "$as/async.out"
+    grep -q "unparked worker 1" "$as/async.out"
+
+    python - "$as" <<'EOF'
+import json, sys, os
+as_dir = sys.argv[1]
+def rounds_t(path):
+    evs = [json.loads(l) for l in open(path)]
+    return [e["t"] for e in evs if e["event"] == "round"], evs
+sync_t, _ = rounds_t(os.path.join(as_dir, "sync.jsonl"))
+async_t, evs = rounds_t(os.path.join(as_dir, "async.jsonl"))
+gaps = lambda ts: sorted(b - a for a, b in zip(ts, ts[1:]))
+med = lambda g: g[len(g) // 2]
+sync_med, async_med = med(gaps(sync_t)), med(gaps(async_t))
+# per-round latency: the sync barrier tracks the straggler (>= the 2 s
+# stall), the async round tracks the median worker (well under it)
+assert sync_med >= 2.0, f"sync rounds did not stall: {sync_med:.2f}s"
+assert async_med <= sync_med - 1.0, \
+    f"async round latency tracks the straggler: {async_med:.2f}s " \
+    f"vs sync {sync_med:.2f}s"
+st = [e for e in evs if e["event"] == "staleness"]
+assert st and any(max(e["lag"]) >= 2 for e in st), "no staleness events"
+assert any(e["event"] == "parked" and e["worker"] == 1 for e in evs)
+assert any(e["event"] == "unparked" and e["worker"] == 1 for e in evs)
+assert not any(e["event"] == "eviction" for e in evs), \
+    "the parked straggler must not be evicted"
+print(f"async stage OK: sync {sync_med:.2f}s/round (tracks the "
+      f"straggler) vs async {async_med:.2f}s/round (tracks the median)")
+EOF
+
+    python -m sparknet_tpu report "$as/async.jsonl" | tee "$as/async.rep" \
+        > /dev/null
+    grep -q "async staleness" "$as/async.rep"
+    grep -q "parks by worker: w1" "$as/async.rep"
+    grep -q "drift attribution" "$as/async.rep"
+    echo "async stage OK: straggler parked+readmitted, round latency" \
+         "tracked the median (async ${async_s}s < budget ${budget}s <" \
+         "sync ${sync_s}s)"
+}
+
 if [ "$stage" = "multihost" ]; then
     run_multihost_stage
     echo "SMOKE OK (multihost)"
+    exit 0
+fi
+if [ "$stage" = "async" ]; then
+    run_async_stage
+    echo "SMOKE OK (async)"
     exit 0
 fi
 
@@ -267,6 +353,8 @@ test "$rc" -eq 4 || { echo "expected exit 4 on quorum loss, got $rc"
                       cat "$tmp/quorum.out"; exit 1; }
 grep -q "QUORUM LOST" "$tmp/quorum.out"
 echo "elasticity stage OK: eviction survived, quorum loss exits 4"
+
+run_async_stage
 
 run_multihost_stage
 

@@ -707,9 +707,13 @@ def cmd_report(args):
     loss-curve summary — human-readable on stdout, machine-readable with
     --json, Chrome trace_event spans with --chrome."""
     from .obs import report as obs_report
+    events = [s for s in (args.event.split(",") if args.event else [])
+              if s.strip()]
     try:
         obs_report.report_file(args.jsonl, json_out=args.json,
-                               chrome_out=args.chrome)
+                               chrome_out=args.chrome,
+                               since=args.since,
+                               event_types=events or None)
     except obs_report.MetricsFileError as e:
         # missing/empty/unreadable metrics is an operator error, not a
         # crash: one line on stderr, distinct exit code
@@ -789,6 +793,28 @@ def _add_elastic_flags(p):
                    help="readmit an evicted worker after an R-round "
                         "cooldown, restarting it from the consensus "
                         "weights (default 5; 0 = never readmit)")
+    p.add_argument("--staleness", type=int, default=None, metavar="S",
+                   help="arm the ASYNC bounded-staleness update mode "
+                        "(the knob next to --tau): rounds are barrier-"
+                        "free — a worker up to S rounds behind the "
+                        "fastest live peer still contributes "
+                        "(staleness-discounted), beyond S it is parked "
+                        "and resynced from the consensus; the round "
+                        "never waits for a straggler. S=0 is bit-for-"
+                        "bit the synchronous masked round")
+    p.add_argument("--s-decay", type=float, default=0.5,
+                   help="geometric per-round-of-lag discount applied "
+                        "to stale contributions in async mode "
+                        "(1.0 = no discount inside the bound)")
+    p.add_argument("--unpark-after", type=int, default=1, metavar="R",
+                   help="rounds a parked (over-stale) worker spends "
+                        "resyncing before it rejoins at the front "
+                        "(async mode; default 1)")
+    p.add_argument("--evict-stale-after", type=int, default=0,
+                   metavar="K",
+                   help="evict a worker after K chronic parks without "
+                        "a sustained in-bound stretch (async mode; "
+                        "0 = park/resync forever, never evict)")
 
 
 def _apply_elastic_flags(solver, args):
@@ -796,13 +822,20 @@ def _apply_elastic_flags(solver, args):
         return
     on = args.quorum > 0 or args.evict_after is not None \
         or args.readmit_after is not None
-    if not on:
-        return
-    solver.arm_elastic(
-        quorum=max(1, args.quorum),
-        evict_after=args.evict_after if args.evict_after is not None else 2,
-        readmit_after=args.readmit_after
-        if args.readmit_after is not None else 5)
+    if on:
+        solver.arm_elastic(
+            quorum=max(1, args.quorum),
+            evict_after=args.evict_after
+            if args.evict_after is not None else 2,
+            readmit_after=args.readmit_after
+            if args.readmit_after is not None else 5)
+    if getattr(args, "staleness", None) is not None and \
+            hasattr(solver, "arm_staleness"):
+        # after arm_elastic: the policy the flags armed gains the
+        # staleness fields (arm_staleness updates it in place)
+        solver.arm_staleness(args.staleness, decay=args.s_decay,
+                             unpark_after=args.unpark_after,
+                             evict_parked_after=args.evict_stale_after)
 
 
 def _add_health_flags(p):
@@ -1116,6 +1149,15 @@ def main(argv=None):
                                    "JSON here (BENCH_*.json-comparable)")
     rp.add_argument("--chrome", help="also export the run's spans as a "
                                      "Chrome trace_event file")
+    rp.add_argument("--since", type=float, default=None, metavar="T",
+                    help="only aggregate events from T seconds into the "
+                         "run on (the JSONL 't' field); selecting zero "
+                         "events is an error (exit 2), never an empty "
+                         "report that reads as healthy")
+    rp.add_argument("--event", metavar="KINDS",
+                    help="comma-separated event kinds to aggregate "
+                         "(e.g. 'health,divergence'); selecting zero "
+                         "events is an error (exit 2)")
     rp.set_defaults(fn=cmd_report)
 
     mo = sub.add_parser("monitor",

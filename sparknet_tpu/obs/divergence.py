@@ -186,6 +186,36 @@ class DivergenceMeter:
                 if v is not None:
                     ev["valid"] = [int(x > 0) for x in
                                    np.asarray(v, np.float64).ravel()]
+        # bounded staleness (async mode): the per-worker version lag and
+        # park state ride along, and the drift is ATTRIBUTED — how much
+        # of this round's worker divergence sits on stale workers vs on
+        # membership holes vs plain tau drift. The attribution is what
+        # lets an operator tell "s is too loose" from "tau is too big".
+        lag = aux.get("lag")
+        if lag is not None:
+            lag = [int(x) for x in np.asarray(lag, np.float64).ravel()]
+            ev["lag"] = lag
+            if aux.get("parked") is not None:
+                ev["parked"] = [int(w) for w in aux["parked"]]
+            w = aux.get("weight")
+            if w is not None:
+                ev["weight"] = [round(float(x), 4) for x in
+                                np.asarray(w, np.float64).ravel()]
+            workers = aux.get("div_worker_sq")
+            if workers is not None:
+                sq = np.asarray(workers, np.float64).ravel()
+                total = float(sq.sum())
+                stale = float(sum(s for s, l in zip(sq, lag) if l > 0))
+                if total > 0:
+                    ev["drift_stale_frac"] = round(stale / total, 4)
+            valid = aux.get("valid")
+            invalid_holes = valid is not None and \
+                bool((np.asarray(valid, np.float64).ravel() <= 0).any())
+            ev["drift_cause"] = (
+                "staleness" if any(l > 0 for l in lag)
+                and ev.get("drift_stale_frac", 0) >= 0.5
+                else "membership" if invalid_holes or aux.get("parked")
+                else "tau")
         self.samples += 1
         self.last = ev
         if emit and self.sink is not None:

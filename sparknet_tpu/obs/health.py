@@ -37,6 +37,16 @@ can alert on:
   host_lease        a live host's lease age crossed half the lease —
                     it is still in the membership but its heartbeats
                     are lagging (pre-failure warning)
+  staleness_high    (async bounded-staleness mode) a live worker's
+                    version lag reached the staleness bound s — its
+                    pushes are about to be excluded; carries
+                    ``suggest_s`` (a bound that would keep it
+                    contributing, the staleness twin of suggest_tau)
+  parked_worker     the bound was hit: a worker is PARKED — excluded
+                    from the consensus until it resyncs. By design, not
+                    a failure, but the paper trail an operator needs to
+                    tell "one chronic straggler" from "the whole fleet
+                    thrashing" (check parks-by-worker in the report)
 
 With an ElasticPolicy armed, the detectors receive the alive mask and
 skip evicted workers — a dead slot's (masked, meaningless) latency or
@@ -93,13 +103,15 @@ class HealthMonitor:
         self.last_alarm = None
         self.straggler_counts = collections.Counter()
         self.tau_suggestion = None
+        self.s_suggestion = None
         self._obs = 0
         self._last_fired = {}           # kind -> observation index
         self._skew_ema = None
         self._div_window = collections.deque(maxlen=self.trend_rounds)
 
     # -- alarm plumbing ----------------------------------------------------
-    def _alarm(self, kind, severity="warn", suggest_tau=None, **fields):
+    def _alarm(self, kind, severity="warn", suggest_tau=None,
+               suggest_s=None, **fields):
         if self._obs - self._last_fired.get(kind, -10**9) < self.cooldown:
             return None
         self._last_fired[kind] = self._obs
@@ -109,11 +121,16 @@ class HealthMonitor:
         if suggest_tau is not None:
             ev["suggest_tau"] = int(suggest_tau)
             self.tau_suggestion = int(suggest_tau)
+        if suggest_s is not None:
+            ev["suggest_s"] = int(suggest_s)
+            self.s_suggestion = int(suggest_s)
         self.last_alarm = ev
         self.log("health: " + kind + " " + " ".join(
             f"{k}={v}" for k, v in fields.items())
             + (f" (suggest tau={suggest_tau})"
-               if suggest_tau is not None else ""))
+               if suggest_tau is not None else "")
+            + (f" (suggest s={suggest_s})"
+               if suggest_s is not None else ""))
         if self.sink is not None:
             self.sink.log("health", **ev)
         if severity == "critical":
@@ -206,6 +223,29 @@ class HealthMonitor:
                 self._alarm("worker_masked", severity="critical",
                             iter=it, round=round_idx, worker=int(ids[i]))
 
+    def _check_staleness(self, it, round_idx, lag, parked, bound,
+                         live=None):
+        """Async-mode detectors: a live worker whose version lag reached
+        the bound is about to be excluded (staleness_high, with a
+        suggest_s that would keep it in), and every freshly-parked
+        worker gets a parked_worker record. Evicted workers' lag is
+        masked garbage and is skipped like every other signal."""
+        if bound is None:
+            return
+        parked = set(int(w) for w in (parked or ()))
+        lagv, ids = self._live_subset(lag, live)
+        for i in range(lagv.size):
+            w = int(ids[i])
+            if w in parked:
+                self._alarm("parked_worker", iter=it, round=round_idx,
+                            worker=w, lag=int(lagv[i]), s=int(bound))
+            elif bound > 0 and lagv[i] >= bound:
+                # one more slow round and it parks: suggest the bound
+                # that would keep this straggler contributing
+                self._alarm("staleness_high", iter=it, round=round_idx,
+                            worker=w, lag=int(lagv[i]), s=int(bound),
+                            suggest_s=int(lagv[i]) + 1)
+
     def _check_divergence(self, it, round_idx, div):
         mean = div.get("mean")
         if not _finite(mean):
@@ -260,11 +300,13 @@ class HealthMonitor:
     # -- public API --------------------------------------------------------
     def observe_round(self, it, round_idx=None, worker_losses=None,
                       latencies=None, divergence=None, valid=None,
-                      alive=None):
+                      alive=None, lag=None, parked=None, staleness=None):
         """Feed one sync round's signals. Any subset may be None.
         ``alive``: the elastic membership mask — evicted workers are
         excluded from every detector. ``valid``: the round's effective
-        per-worker validity vector (alive AND device-finite)."""
+        per-worker validity vector (alive AND device-finite). ``lag``/
+        ``parked``/``staleness``: the async mode's per-worker version
+        lag, parked worker ids, and the bound s."""
         self._obs += 1
         try:
             live = None
@@ -277,13 +319,19 @@ class HealthMonitor:
                 self._check_loss_skew(it, round_idx, worker_losses, live)
             if valid is not None:
                 self._check_validity(it, round_idx, valid, live)
+            if lag is not None:
+                self._check_staleness(it, round_idx, lag, parked,
+                                      staleness, live)
             if divergence:
                 self._check_divergence(it, round_idx, divergence)
         except Exception as e:          # detectors must never kill a run
             self.log(f"health: detector error: {e!r}")
 
     def summary(self):
-        return {"observations": self._obs, "alarms": self.alarms,
-                "stragglers_by_worker": dict(self.straggler_counts),
-                "last_alarm": self.last_alarm,
-                "tau_suggestion": self.tau_suggestion}
+        out = {"observations": self._obs, "alarms": self.alarms,
+               "stragglers_by_worker": dict(self.straggler_counts),
+               "last_alarm": self.last_alarm,
+               "tau_suggestion": self.tau_suggestion}
+        if self.s_suggestion is not None:
+            out["s_suggestion"] = self.s_suggestion
+        return out

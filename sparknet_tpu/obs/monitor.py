@@ -63,6 +63,11 @@ class MonitorState:
         self.last_eviction = None
         self.readmissions = 0
         self.quorum_lost = None
+        # async bounded staleness (resilience/elastic.py, ISSUE 7)
+        self.staleness = None       # last staleness event (lag/version)
+        self.parks = collections.Counter()       # worker -> park count
+        self.unparks = 0
+        self.last_park = None
         # host fault domains (resilience/heartbeat.py)
         self.host_alive = {}        # host -> bool (last transition)
         self.host_lease_age = None  # last per-host lease-age vector
@@ -144,6 +149,14 @@ class MonitorState:
                 self.coordinated_restart = ev
             if _num(ev.get("live")):
                 self.live = ev["live"]
+        elif kind == "staleness":
+            self.staleness = ev
+        elif kind == "parked":
+            if ev.get("worker") is not None:
+                self.parks[ev["worker"]] += 1
+            self.last_park = ev
+        elif kind == "unparked":
+            self.unparks += 1
         elif kind == "host_alive":
             if ev.get("host") is not None:
                 self.host_alive[int(ev["host"])] = bool(ev.get("alive"))
@@ -229,6 +242,28 @@ class MonitorState:
                 q = self.quorum_lost
                 L.append(f"    QUORUM LOST: {q.get('live')} live < "
                          f"quorum {q.get('quorum')}")
+        if self.staleness or self.parks or self.unparks:
+            bits = []
+            st = self.staleness or {}
+            if _num(st.get("s")):
+                bits.append(f"s={st['s']}")
+            if isinstance(st.get("lag"), list):
+                bits.append("lag " + self._fmt_workers(st["lag"], "{:d}"))
+            if isinstance(st.get("parked"), list) and st["parked"]:
+                bits.append(f"parked {st['parked']}")
+            bits.append(f"parks {sum(self.parks.values())}"
+                        + (" (" + ", ".join(
+                            f"w{w}:{c}" for w, c in
+                            self.parks.most_common()) + ")"
+                           if self.parks else ""))
+            if self.unparks:
+                bits.append(f"unparks {self.unparks}")
+            L.append("  staleness: " + "  ".join(bits))
+            if self.last_park is not None:
+                p = self.last_park
+                L.append(f"    last park: {p.get('unit', 'worker')} "
+                         f"{p.get('worker')} round {p.get('round')} "
+                         f"(lag {p.get('lag')})")
         if self.host_alive or self.host_gate or self.host_evictions:
             bits = []
             if self.host_alive:

@@ -242,6 +242,46 @@ def aggregate(events):
                 if last.get("sha") else None,
                 "hosts": last.get("hosts")}
         rep["multihost"] = mh
+    # bounded staleness (the async local-SGD mode): per-worker version
+    # lag / park-time accounting + drift attribution
+    st = [e for e in events if e.get("event") == "staleness"]
+    pk = [e for e in events if e.get("event") == "parked"]
+    up = [e for e in events if e.get("event") == "unparked"]
+    if st or pk or up:
+        sa = {"parks": len(pk), "unparks": len(up)}
+        if pk:
+            sa["parks_by_worker"] = {
+                str(k): v for k, v in collections.Counter(
+                    e.get("worker") for e in pk).items()}
+        if up:
+            sa["park_rounds_total"] = sum(
+                e.get("parked_rounds") or 0 for e in up)
+        if st:
+            last = st[-1]
+            if last.get("s") is not None:
+                sa["s"] = last["s"]
+            if isinstance(last.get("lag"), list):
+                sa["last_lag"] = last["lag"]
+            if isinstance(last.get("version"), list):
+                sa["last_version"] = last["version"]
+            if isinstance(last.get("park_rounds"), list):
+                sa["park_rounds_by_worker"] = {
+                    str(w): r for w, r in enumerate(last["park_rounds"])
+                    if r}
+            lags = [max(e["lag"]) for e in st
+                    if isinstance(e.get("lag"), list) and e["lag"]]
+            if lags:
+                sa["max_lag"] = max(lags)
+        div = [e for e in events if e.get("event") == "divergence"
+               and e.get("drift_cause")]
+        if div:
+            sa["drift_cause"] = dict(collections.Counter(
+                e["drift_cause"] for e in div))
+            fracs = [e["drift_stale_frac"] for e in div
+                     if _num(e.get("drift_stale_frac"))]
+            if fracs:
+                sa["drift_stale_frac_last"] = fracs[-1]
+        rep["staleness"] = sa
     cp = [e for e in events if e.get("event") == "checkpoint"]
     if cp:
         writes = [e for e in cp if e.get("kind") != "resume"]
@@ -296,6 +336,9 @@ def aggregate(events):
         taus = [e["suggest_tau"] for e in hl if _num(e.get("suggest_tau"))]
         if taus:
             h["suggest_tau"] = taus[-1]
+        esses = [e["suggest_s"] for e in hl if _num(e.get("suggest_s"))]
+        if esses:
+            h["suggest_s"] = esses[-1]
         rep["health"] = h
     mem = [e for e in events if e.get("event") == "memstats"]
     if mem:
@@ -490,6 +533,32 @@ def render(rep):
                 L.append(f"    QUORUM LOST at round {q.get('round')}: "
                          f"{q.get('live')} live < quorum "
                          f"{q.get('quorum')} (exit 4)")
+    sa = rep.get("staleness")
+    if sa:
+        hdr("async staleness (bounded-staleness local SGD)")
+        line = f"  parks: {sa.get('parks', 0)}, unparks: " \
+               f"{sa.get('unparks', 0)}"
+        if _num(sa.get("s")):
+            line += f", bound s={sa['s']}"
+        if _num(sa.get("max_lag")):
+            line += f", max lag seen {sa['max_lag']}"
+        L.append(line)
+        if sa.get("parks_by_worker"):
+            L.append("  parks by worker: " + ", ".join(
+                f"w{k}: {v}" for k, v in sorted(
+                    sa["parks_by_worker"].items())))
+        if _num(sa.get("park_rounds_total")):
+            L.append(f"  total park time: {sa['park_rounds_total']} "
+                     "round(s)")
+        if sa.get("last_lag") is not None:
+            L.append(f"  last version lag per worker: {sa['last_lag']}")
+        if sa.get("drift_cause"):
+            L.append("  drift attribution: " + ", ".join(
+                f"{k}: {v}" for k, v in sorted(
+                    sa["drift_cause"].items()))
+                + (f" (last stale share "
+                   f"{sa['drift_stale_frac_last']})"
+                   if _num(sa.get("drift_stale_frac_last")) else ""))
     mh = rep.get("multihost")
     if mh:
         hdr("multi-host fault domains")
@@ -567,6 +636,9 @@ def render(rep):
                 L.append(f"    last alarm: [{la.get('kind')}] {detail}")
             if _num(h.get("suggest_tau")):
                 L.append(f"    suggested tau: {h['suggest_tau']}")
+            if _num(h.get("suggest_s")):
+                L.append(f"    suggested staleness bound s: "
+                         f"{h['suggest_s']}")
         m = rep.get("memstats")
         if m:
             bits = [f"{m.get('samples')} samples"]
@@ -611,15 +683,52 @@ def render(rep):
     return "\n".join(L)
 
 
-def report_file(jsonl_path, json_out=None, chrome_out=None, out=print):
+def filter_events(events, since=None, event_types=None):
+    """Apply the report's --since / --event selection. ``since``: keep
+    events with t >= since (seconds into the run — the ``t`` field every
+    MetricsLogger line carries); ``event_types``: iterable of event
+    names to keep. Returns the filtered list; the CALLER must treat an
+    empty result as an error — an empty report renders exactly like
+    "all healthy", which is the dangerous lie the exit-2 contract
+    prevents."""
+    out = events
+    if since is not None:
+        out = [e for e in out
+               if isinstance(e.get("t"), (int, float))
+               and e["t"] >= float(since)]
+    if event_types:
+        keep = {str(k) for k in event_types}
+        out = [e for e in out if e.get("event") in keep]
+    return out
+
+
+def report_file(jsonl_path, json_out=None, chrome_out=None, out=print,
+                since=None, event_types=None):
     """Load + aggregate + render; optionally write JSON / Chrome trace.
-    The implementation behind `sparknet report`."""
+    The implementation behind `sparknet report`. ``since``/
+    ``event_types`` select a slice of the stream; a selection that
+    matches ZERO events raises MetricsFileError (exit 2 at the CLI) —
+    never an empty report that reads as "all healthy"."""
     events, bad = load_events(jsonl_path)
     if not events:
         raise MetricsFileError(
             f"metrics file has no parseable events: {jsonl_path}"
             + (f" ({bad} malformed line(s) skipped)" if bad
                else " (file is empty)"))
+    if since is not None or event_types:
+        selected = filter_events(events, since=since,
+                                 event_types=event_types)
+        if not selected:
+            sel = []
+            if since is not None:
+                sel.append(f"--since {since}")
+            if event_types:
+                sel.append(f"--event {','.join(sorted(event_types))}")
+            raise MetricsFileError(
+                f"{' '.join(sel)} selected 0 of {len(events)} events in "
+                f"{jsonl_path} — refusing to print an empty report that "
+                "would read as healthy")
+        events = selected
     rep = aggregate(events)
     if bad:
         rep["malformed_lines"] = bad

@@ -26,7 +26,9 @@ from ..solver.solver import Solver
 from ..obs.divergence import (tree_sq_dist, _sq_sum,
                               gather_worker_scalar)
 from ..resilience.elastic import (masked_consensus, masked_consensus_stats,
-                                  masked_scalar_mean, tree_finite)
+                                  masked_scalar_mean, tree_finite,
+                                  staleness_discount, weighted_consensus,
+                                  weighted_consensus_stats)
 from .mesh import DATA_AXIS
 from . import context
 from .compat import shard_map
@@ -202,7 +204,8 @@ class DataParallelSolver(Solver):
     training on the same *global* batch, matching Caffe's semantics where
     the loss is already normalized by the full batch size."""
 
-    def __init__(self, solver_param, mesh=None, axis=DATA_AXIS, **kw):
+    def __init__(self, solver_param, mesh=None, axis=DATA_AXIS,
+                 staleness=None, s_decay=0.5, **kw):
         from .mesh import make_mesh
         self.mesh = mesh if mesh is not None else make_mesh({axis: -1})
         self.axis = axis
@@ -213,6 +216,10 @@ class DataParallelSolver(Solver):
         self.local_net = _rebatch(self.net, n)
         self.local_test_net = _rebatch(self.test_net, n) \
             if self.test_net is not None else None
+        if staleness is not None:
+            # async bounded staleness at step granularity (the LocalSGD
+            # round-granularity twin — see LocalSGDSolver)
+            self.arm_staleness(staleness, decay=s_decay)
 
     # -- compiled steps ----------------------------------------------------
     def _sharded_step(self, batch_example):
@@ -231,6 +238,11 @@ class DataParallelSolver(Solver):
         # consensus with its weight renormalized over the live count —
         # bit-for-bit the old pmean when every worker is valid
         elastic_on = self.elastic is not None
+        # async bounded staleness -> the gradient consensus additionally
+        # discounts each shard by its version lag (step-granularity
+        # versions; lag is a traced input, zero recompiles)
+        async_on = self.staleness is not None and elastic_on
+        s_bound, s_decay = self.staleness, self.s_decay
         loss_fn = self._wrapped_loss(net)   # device-side input transform
         # (shape-polymorphic vmap, so the global-net transform applies
         # unchanged to each shard's slice)
@@ -243,7 +255,7 @@ class DataParallelSolver(Solver):
                 lf, has_aux=True)(params)
             return loss, grads, new_state
 
-        def step(params, state, history, batch, it, rng, alive):
+        def step(params, state, history, batch, it, rng, alive, lag):
             # per-device rng stream (dropout must differ across shards)
             w = jax.lax.axis_index(axis)
             my_alive = alive[w]
@@ -270,25 +282,45 @@ class DataParallelSolver(Solver):
                 valid = my_alive * finite.astype(jnp.float32)
             else:
                 valid = my_alive
+            if async_on:
+                sweight = valid * staleness_discount(lag[w], s_bound,
+                                                     s_decay)
+                inc = (sweight > 0).astype(jnp.float32)
+            else:
+                sweight = valid
+                inc = valid
             # THE collective: replaces P2PSync's up-tree gradient sum —
             # with stats on, masked_consensus_stats is the same masked
             # average plus each live shard's drift from it (the
             # gradient noise)
             if with_stats:
-                grads, aux = masked_consensus_stats(grads, valid, axis)
+                if async_on:
+                    grads, aux = weighted_consensus_stats(grads, valid,
+                                                          sweight, axis)
+                else:
+                    grads, aux = masked_consensus_stats(grads, valid, axis)
                 aux["ref_sq"] = _sq_sum(grads)
                 aux["worker_loss"] = gather_worker_scalar(loss, axis)
             elif elastic_on:
-                grads, n_live = masked_consensus(grads, valid, axis)
+                if async_on:
+                    grads, _ = weighted_consensus(grads, sweight, axis)
+                    n_live = jax.lax.psum(inc, axis)
+                else:
+                    grads, n_live = masked_consensus(grads, valid, axis)
                 aux = {"valid": jax.lax.all_gather(valid, axis),
                        "n_live": n_live,
                        "worker_loss": gather_worker_scalar(loss, axis)}
+                if async_on:
+                    aux["weight"] = jax.lax.all_gather(sweight, axis)
             else:
                 grads, _ = masked_consensus(grads, valid, axis)
                 aux = {}
-            loss = masked_scalar_mean(loss, valid, axis)
+            loss = masked_scalar_mean(loss, inc, axis)
             # BN running stats etc. must stay replicated
-            state, _ = masked_consensus(state, valid, axis)
+            if async_on:
+                state, _ = weighted_consensus(state, sweight, axis)
+            else:
+                state, _ = masked_consensus(state, valid, axis)
             params, history = updater(params, grads, history, lr_fn(it), it)
             return params, state, history, loss, aux
 
@@ -299,7 +331,7 @@ class DataParallelSolver(Solver):
                                       elastic=elastic_on):
             sharded = shard_map(
                 step, mesh=self.mesh,
-                in_specs=(P(), P(), P(), bspec, P(), P(), P()),
+                in_specs=(P(), P(), P(), bspec, P(), P(), P(), P()),
                 out_specs=(P(), P(), P(), P(), P()),
                 check_vma=False)
             return jax.jit(sharded, donate_argnums=(0, 1, 2))
@@ -339,10 +371,20 @@ class DataParallelSolver(Solver):
                                 else 1)
         self.params, self.state, self.history, loss, aux = self._jit_train(
             self.params, self.state, self.history, dev_batch,
-            jnp.asarray(self.iter, jnp.int32), key, self._alive_mask())
+            jnp.asarray(self.iter, jnp.int32), key, self._alive_mask(),
+            self._staleness_lag())
         self.iter += 1
         host_s = _t.perf_counter() - t0
         self._timing["train_step"] += host_s
+        if self.staleness is not None and self.elastic is not None:
+            # step-granularity version clocks: the DP twin of the
+            # LocalSGD round bookkeeping (park/unpark events flow from
+            # the policy itself)
+            it = self.iter - 1
+            slow = self.chaos.slow_worker_spec(it) \
+                if self.chaos is not None else None
+            self.elastic.advance_versions(it, host_s, slow=slow)
+            self.elastic.observe_staleness(it)
         self._obs_step(host_s, loss, batch,
                        aux=dict(aux, kind="grads") if aux else None)
         if aux and self.elastic is not None and self.stepstats is None:
@@ -414,10 +456,25 @@ class LocalSGDSolver(Solver):
     unit (preemption/OOM kill whole processes, not single chips). With
     one device per host the inner tier is skipped at trace time, so the
     round is bit-for-bit the single-tier SparkNet round it generalizes.
+
+    staleness: arms the ASYNCHRONOUS bounded-staleness mode (`--staleness
+    s` next to `--tau`): workers push versioned contributions and the
+    round's collect & average becomes a staleness-weighted consensus
+    (resilience/elastic.py) — a worker ``lag`` rounds behind the fastest
+    live peer is discounted by ``s_decay ** lag``, parked (excluded,
+    still a member) once ``lag > s``, and resynced from the replicated
+    consensus after the cooldown. The round never blocks on a straggler:
+    a chaos ``slow_worker``'s injected seconds land on its own virtual
+    clock (its lag grows) instead of the host loop, so round latency
+    tracks the median worker, not the max. s=0 is BIT-FOR-BIT the
+    synchronous masked round (the same guarantee style as the all-valid
+    masked pmean); the lag vector is a traced input, so staleness
+    changes cost zero recompiles.
     """
 
     def __init__(self, solver_param, mesh=None, axis=DATA_AXIS, tau=10,
-                 average_history=False, unroll=None, host_axis=None, **kw):
+                 average_history=False, unroll=None, host_axis=None,
+                 staleness=None, s_decay=0.5, **kw):
         from .mesh import make_mesh, make_host_device_mesh
         self.host_axis = host_axis
         if mesh is None:
@@ -442,6 +499,8 @@ class LocalSGDSolver(Solver):
         super().__init__(solver_param, **kw)
         self._jit_round = None
         self._round_idx = 0
+        if staleness is not None:
+            self.arm_staleness(staleness, decay=s_decay)
 
     def _build_round(self, batch_example):
         net, updater, lr_fn = self.net, self.updater, self.lr_fn
@@ -477,6 +536,12 @@ class LocalSGDSolver(Solver):
         # workers are excluded and the weights renormalize over the live
         # count — bit-for-bit the old pmean when every worker is valid
         elastic_on = self.elastic is not None
+        # async bounded staleness armed -> the average is additionally
+        # weighted by each worker's version lag (a traced input like the
+        # alive mask — zero recompiles); all-lag-zero weights are
+        # exactly 1.0, so s=0 stays the synchronous round bit for bit
+        async_on = self.staleness is not None and elastic_on
+        s_bound, s_decay = self.staleness, self.s_decay
         loss_fn = self._wrapped_loss(net)
 
         def one_step(params, state, history, batch, it, rng):
@@ -503,7 +568,7 @@ class LocalSGDSolver(Solver):
             return jax.tree_util.tree_map(
                 lambda v: jax.lax.pmean(v, axis), x)
 
-        def round_fn(params, state, history, batches, it0, rng, alive):
+        def round_fn(params, state, history, batches, it0, rng, alive, lag):
             params_in = params          # the round's broadcast weights
             w = jax.lax.axis_index(sync_axis)
             my_alive = alive[w]
@@ -540,6 +605,19 @@ class LocalSGDSolver(Solver):
                 valid = my_alive * finite
             else:
                 valid = my_alive
+            if async_on:
+                # bounded staleness: this worker's push is discounted by
+                # its version lag; over the bound the discount is 0 and
+                # the same where-mask that excludes dead workers applies
+                # — stale and dead degrade identically. valid stays the
+                # MEMBERSHIP bit (a parked-but-healthy worker must not
+                # accrue "nonfinite" eviction streaks).
+                sweight = valid * staleness_discount(lag[w], s_bound,
+                                                     s_decay)
+                inc = (sweight > 0).astype(jnp.float32)
+            else:
+                sweight = valid
+                inc = valid
             # the per-worker (per-host, hierarchically) round loss: mean
             # over tau steps, folded over the host's devices
             local_loss = intra_mean(jnp.mean(losses))
@@ -550,35 +628,57 @@ class LocalSGDSolver(Solver):
             # each live worker's drift from the result (the paper's tau
             # drift), and ref_sq is the consensus round update's sq norm
             if with_stats:
-                params, aux = masked_consensus_stats(params, valid,
-                                                     sync_axis)
+                if async_on:
+                    params, aux = weighted_consensus_stats(
+                        params, valid, sweight, sync_axis)
+                else:
+                    params, aux = masked_consensus_stats(params, valid,
+                                                         sync_axis)
                 aux["ref_sq"] = tree_sq_dist(params, params_in)[1]
                 aux["worker_loss"] = gather_worker_scalar(local_loss,
                                                           sync_axis)
             elif elastic_on:
-                params, n_live = masked_consensus(params, valid, sync_axis)
+                if async_on:
+                    params, _ = weighted_consensus(params, sweight,
+                                                   sync_axis)
+                    n_live = jax.lax.psum(inc, sync_axis)
+                else:
+                    params, n_live = masked_consensus(params, valid,
+                                                      sync_axis)
                 aux = {"valid": jax.lax.all_gather(valid, sync_axis),
                        "n_live": n_live,
                        "worker_loss": gather_worker_scalar(local_loss,
                                                            sync_axis)}
+                if async_on:
+                    aux["weight"] = jax.lax.all_gather(sweight, sync_axis)
             else:
                 params, _ = masked_consensus(params, valid, sync_axis)
                 aux = {}
             # BN running stats differ per device (each saw its own
             # shard): fold within the host first, then the masked
-            # cross-host consensus
-            state, _ = masked_consensus(intra_mean(state), valid, sync_axis)
-            if average_history:
-                # history is already replicated within a host (identical
-                # pmean'd grads drive identical updates), so only the
-                # cross-host average is needed
-                history, _ = masked_consensus(history, valid, sync_axis)
-            # the round loss is the mean over the LIVE workers' tau
+            # cross-host consensus (staleness-weighted in async mode,
+            # like the params they ran under)
+            if async_on:
+                state, _ = weighted_consensus(intra_mean(state), sweight,
+                                              sync_axis)
+                if average_history:
+                    history, _ = weighted_consensus(history, sweight,
+                                                    sync_axis)
+            else:
+                state, _ = masked_consensus(intra_mean(state), valid,
+                                            sync_axis)
+                if average_history:
+                    # history is already replicated within a host
+                    # (identical pmean'd grads drive identical updates),
+                    # so only the cross-host average is needed
+                    history, _ = masked_consensus(history, valid,
+                                                  sync_axis)
+            # the round loss is the mean over the INCLUDED workers' tau
             # steps — without the collective the P() out_spec would hand
             # back whichever worker's mean sits on the fetching host's
             # first device (observably different across hosts/modes)
             return params, state, history, \
-                masked_scalar_mean(local_loss, valid, sync_axis), aux
+                masked_scalar_mean(local_loss, inc, sync_axis), aux
 
         shard_axes = (host_axis, axis) if host_axis is not None else axis
         bspec = _batch_specs(batch_example, shard_axes, batch_dim=1)
@@ -590,7 +690,7 @@ class LocalSGDSolver(Solver):
                 context.world_context(**world_kw):
             sharded = shard_map(
                 round_fn, mesh=self.mesh,
-                in_specs=(P(), P(), P(), bspec, P(), P(), P()),
+                in_specs=(P(), P(), P(), bspec, P(), P(), P(), P()),
                 out_specs=(P(), P(), P(), P(), P()),
                 check_vma=False)
             return jax.jit(sharded, donate_argnums=(0, 1, 2))
@@ -641,7 +741,17 @@ class LocalSGDSolver(Solver):
             return None
         lat = [float(round_s)] * n
         if self.chaos is not None:
+            if self.staleness is not None:
+                # async mode: the straggler's injected seconds never
+                # blocked the host loop (round_s IS the median pace), so
+                # its latency is attributed VIRTUALLY — the per-worker
+                # timer a real async runtime would report
+                spec = self.chaos.slow_worker_spec(self._round_idx)
+                if spec is not None and 0 <= spec[0] < n:
+                    lat[spec[0]] = float(round_s) + float(spec[1])
+                return lat
             rep = self.chaos.pop_stall()
+            rep = self.chaos.pop_slow_worker() or rep
             if self.host_axis is not None:
                 rep = self.chaos.pop_slow_host() or rep
             if rep and rep[0] is not None and 0 <= rep[0] < n:
@@ -712,7 +822,7 @@ class LocalSGDSolver(Solver):
             procs.append(owners.pop() if len(owners) == 1 else None)
         return procs
 
-    def _heartbeat_gate(self):
+    def _heartbeat_gate(self, timeout=None):
         """The no-hang contract: arrive at this round's rendezvous and
         wait until every live peer host arrived or its lease expired.
         Lease-dead hosts are evicted at host granularity (zero
@@ -720,14 +830,21 @@ class LocalSGDSolver(Solver):
         owns devices of the training mesh, the survivors additionally
         shrink the mesh before dispatching, because a collective over a
         dead process's devices would hang forever. QuorumLost
-        propagates to run(), which drives the coordinated restart."""
+        propagates to run(), which drives the coordinated restart.
+
+        In the async bounded-staleness mode the caller passes
+        ``timeout=0``: arrival is still announced (peers read our round
+        version from it) and lease-expired peers are still evicted, but
+        the round NEVER waits for stragglers — that is the whole
+        point; their contributions are staleness-discounted at the
+        exchange instead."""
         from ..resilience.elastic import QuorumLost
         hb = self.heartbeat
         if self.elastic is not None and self.elastic.n == hb.n:
             expect = set(self.elastic.live())
         else:
             expect = set(range(hb.n))
-        res = hb.gate(self._round_idx, expect=expect)
+        res = hb.gate(self._round_idx, expect=expect, timeout=timeout)
         if self.health is not None:
             alive_now, ages = hb.view()
             self.health.observe_hosts(self._round_idx, alive=alive_now,
@@ -774,7 +891,8 @@ class LocalSGDSolver(Solver):
         dev = shard_batch(batches, self.mesh, shard_axes, batch_dim=1)
         self.params, self.state, self.history, loss, _ = self._jit_round(
             self.params, self.state, self.history, dev,
-            jnp.asarray(self.iter, jnp.int32), key, self._alive_mask())
+            jnp.asarray(self.iter, jnp.int32), key, self._alive_mask(),
+            self._staleness_lag())
         self.iter += self.tau
         # tier 2: fetch (replicated locally — one local device read),
         # exchange through the directory, adopt the consensus
@@ -825,12 +943,16 @@ class LocalSGDSolver(Solver):
         over the round."""
         import time as _t
         batches = {k: np.asarray(v) for k, v in batches.items()}
+        async_on = self.staleness is not None
         if self.heartbeat is not None:
             # the round gate: never dispatch a cross-host collective
             # until every supposedly-live peer host has arrived (or its
             # lease expired and it was evicted) — a dead peer must cost
-            # an eviction, not a hang inside the collective
-            self._heartbeat_gate()
+            # an eviction, not a hang inside the collective. The async
+            # mode gates with timeout=0: arrival is announced and
+            # lease-dead peers are evicted, but stragglers are never
+            # waited for (their pushes get staleness-discounted instead)
+            self._heartbeat_gate(timeout=0.0 if async_on else None)
         if self._relay is not None:
             return self._train_round_relay(batches)
         if self._jit_round is None:
@@ -842,20 +964,59 @@ class LocalSGDSolver(Solver):
         dev = shard_batch(batches, self.mesh, shard_axes, batch_dim=1)
         self.params, self.state, self.history, loss, aux = self._jit_round(
             self.params, self.state, self.history, dev,
-            jnp.asarray(self.iter, jnp.int32), key, self._alive_mask())
+            jnp.asarray(self.iter, jnp.int32), key, self._alive_mask(),
+            self._staleness_lag())
         self.iter += self.tau
         host_s = _t.perf_counter() - t0
         self._timing["train_round"] += host_s
         self._obs_step(host_s, loss, batches)
         loss = self._chaos_loss(loss)   # may stall (the injected straggler)
+        if self.chaos is not None and not async_on:
+            # a chaos slow_worker under the SYNCHRONOUS barrier is a
+            # real per-round host stall: the collect & average waits for
+            # the straggler, so round latency tracks the max worker —
+            # exactly the failure mode the async mode absorbs
+            self.chaos.maybe_slow_worker(self._round_idx)
+        aux = dict(aux, kind="params") if aux else None
+        if async_on and self.elastic is not None:
+            aux = self._observe_staleness_round(
+                aux, _t.perf_counter() - t0)
         if aux:
             # once per sync round (rounds are coarse; the fetch is a few
             # scalars): divergence event + straggler/skew/trend detectors
             self._observe_sync_round(
-                dict(aux, kind="params"),
-                round_s=_t.perf_counter() - t0, round_idx=self._round_idx)
+                aux, round_s=_t.perf_counter() - t0,
+                round_idx=self._round_idx)
         self._round_idx += 1
         return loss
+
+    def _observe_staleness_round(self, aux, round_s):
+        """Async-mode per-round bookkeeping: advance the per-worker
+        version clocks (a chaos slow_worker pays its seconds on ITS
+        clock, never the host loop's), run the park/unpark controller,
+        attach the lag/park state to the round aux (drift attribution +
+        the health detectors), and emit the ``staleness`` metrics event
+        the report/monitor staleness sections render. QuorumLost (a
+        chronically-parked worker evicted below quorum) propagates."""
+        el = self.elastic
+        slow = self.chaos.slow_worker_spec(self._round_idx) \
+            if self.chaos is not None else None
+        lag_used = el.lag()             # the lag the round's weights saw
+        el.advance_versions(self._round_idx, round_s, slow=slow)
+        el.observe_staleness(self._round_idx)
+        aux = dict(aux) if aux else {"kind": "params"}
+        aux["lag"] = [int(x) for x in lag_used]
+        aux["parked"] = [int(w) for w in np.nonzero(el.parked)[0]]
+        if self.metrics is not None:
+            self.metrics.log(
+                "staleness", round=self._round_idx, s=el.staleness,
+                version=[int(v) for v in el.version],
+                lag=[int(x) for x in el.lag()],
+                parked=aux["parked"],
+                park_rounds=[int(r) for r in el.park_rounds],
+                weight=[round(float(x), 4)
+                        for x in el.consensus_weights()])
+        return aux
 
     def run(self, num_rounds, batch_fn, test_data_fn=None, test_every=10,
             snapshot_prefix=None, snapshot_every=0, resume=None,

@@ -56,8 +56,11 @@ from .retry import RetryPolicy, RetryExhausted, retry_from_env
 from .chaos import ChaosMonkey, ChaosIOError, install_chaos, active_chaos
 from .elastic import (ElasticPolicy, QuorumLost, EXIT_QUORUM_LOST,
                       masked_consensus, masked_consensus_stats,
-                      masked_scalar_mean, tree_finite, expand_to_slots)
-from .heartbeat import (HeartbeatCoordinator, FileConsensus, GateResult,
+                      masked_scalar_mean, tree_finite, expand_to_slots,
+                      staleness_discount, weighted_consensus,
+                      weighted_consensus_stats)
+from .heartbeat import (HeartbeatCoordinator, FileConsensus,
+                        AsyncFileConsensus, GateResult,
                         manifest_sha, restart_barrier)
 
 __all__ = [
@@ -70,6 +73,7 @@ __all__ = [
     "ElasticPolicy", "QuorumLost", "EXIT_QUORUM_LOST",
     "masked_consensus", "masked_consensus_stats", "masked_scalar_mean",
     "tree_finite", "expand_to_slots",
-    "HeartbeatCoordinator", "FileConsensus", "GateResult",
-    "manifest_sha", "restart_barrier",
+    "staleness_discount", "weighted_consensus", "weighted_consensus_stats",
+    "HeartbeatCoordinator", "FileConsensus", "AsyncFileConsensus",
+    "GateResult", "manifest_sha", "restart_barrier",
 ]

@@ -45,11 +45,27 @@ subsystem claims to survive — on a schedule tests can replay exactly:
                    (once at round R, or every round with slow_repeat) —
                    the host-granularity straggler the health detectors
                    must name
+  slow_worker=W, slow_s=S, slow_round=R
+                   worker W is a PERSISTENT straggler from round R
+                   (default 0): every local round costs it S extra
+                   seconds. Synchronous solvers render it as a real
+                   host stall per round (the barrier waits — round
+                   latency tracks the straggler, the paper's failure
+                   mode); the async bounded-staleness mode instead
+                   feeds S to the virtual version clocks
+                   (ElasticPolicy.advance_versions) and NEVER sleeps —
+                   the round proceeds at the median worker's pace and
+                   W's lag grows until it parks. The sync-vs-async
+                   wall-clock gap under this injector IS the mode's
+                   acceptance test (scripts/smoke.sh async stage).
 
 Armed via `--chaos "nan_step=30,io_p=0.02,seed=1"` or the SPARKNET_CHAOS
 env var (same spec), which data sources and solvers pick up through
-active_chaos() without any plumbing. Every injection logs a ``chaos``
-metrics event so a report never confuses injected faults with real ones.
+active_chaos() without any plumbing. Unknown or malformed tokens raise a
+ValueError naming the offending token and listing the valid injectors —
+a typo'd spec must never let a resilience test pass vacuously. Every
+injection logs a ``chaos`` metrics event so a report never confuses
+injected faults with real ones.
 """
 
 import os
@@ -93,6 +109,7 @@ class ChaosMonkey:
                  partition_host=None, partition_round=0,
                  slow_host=None, slow_host_s=0.0, slow_host_round=0,
                  slow_repeat=False,
+                 slow_worker=None, slow_s=0.0, slow_round=0,
                  seed=0, metrics=None, log_fn=print):
         self.nan_step = None if nan_step is None else int(nan_step)
         self.nan_repeat = bool(nan_repeat)
@@ -127,6 +144,12 @@ class ChaosMonkey:
         self.slow_repeat = bool(slow_repeat)
         self._slow_fired = False
         self._last_slow = None
+        # the worker-granularity persistent straggler (async local SGD)
+        self.slow_worker = None if slow_worker is None else int(slow_worker)
+        self.slow_s = float(slow_s)
+        self.slow_round = int(slow_round)
+        self._slow_worker_logged = False
+        self._last_slow_worker = None
         self._rng = np.random.RandomState(seed)
         self.metrics = metrics
         self.log = log_fn or (lambda *a: None)
@@ -138,18 +161,12 @@ class ChaosMonkey:
     @classmethod
     def parse(cls, spec, **kw):
         """"nan_step=30,io_p=0.05,stall_step=10,stall_s=2,sigterm_round=3,
-        seed=1" -> ChaosMonkey. Unknown keys are an error (a typo'd chaos
-        spec silently injecting nothing would fake a green test)."""
-        fields = {}
-        for part in spec.split(","):
-            part = part.strip()
-            if not part:
-                continue
-            k, eq, v = part.partition("=")
-            if not eq:
-                raise ValueError(f"chaos spec needs key=value, got {part!r}")
-            fields[k.strip()] = v.strip()
-        truthy = lambda v: v not in ("0", "false", "False", "")  # noqa: E731
+        seed=1" -> ChaosMonkey. Unknown keys AND malformed values are an
+        error naming the offending token and listing the valid injectors
+        — a typo'd chaos spec silently injecting nothing would fake a
+        green resilience test."""
+        def truthy(v):
+            return v not in ("0", "false", "False", "")
         known = {"nan_step": int, "nan_repeat": truthy, "io_p": float,
                  "stall_step": int, "stall_s": float,
                  "stall_worker": int, "stall_repeat": truthy,
@@ -159,12 +176,29 @@ class ChaosMonkey:
                  "partition_host": int, "partition_round": int,
                  "slow_host": int, "slow_host_s": float,
                  "slow_host_round": int, "slow_repeat": truthy,
+                 "slow_worker": int, "slow_s": float, "slow_round": int,
                  "seed": int}
-        unknown = set(fields) - set(known)
-        if unknown:
-            raise ValueError(f"unknown chaos keys {sorted(unknown)} "
-                             f"(known: {sorted(known)})")
-        return cls(**{k: known[k](v) for k, v in fields.items()}, **kw)
+        valid = f"valid injectors: {', '.join(sorted(known))}"
+        fields = {}
+        for part in spec.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            k, eq, v = part.partition("=")
+            k, v = k.strip(), v.strip()
+            if not eq:
+                raise ValueError(f"chaos spec token {part!r}: expected "
+                                 f"key=value; {valid}")
+            if k not in known:
+                raise ValueError(f"chaos spec token {part!r}: unknown "
+                                 f"injector {k!r}; {valid}")
+            try:
+                fields[k] = known[k](v)
+            except (TypeError, ValueError):
+                raise ValueError(
+                    f"chaos spec token {part!r}: bad value {v!r} for "
+                    f"{k} (expects {known[k].__name__}); {valid}") from None
+        return cls(**fields, **kw)
 
     def _event(self, kind, **fields):
         self.injected += 1
@@ -317,4 +351,40 @@ class ChaosMonkey:
         call, or None — how the round-latency probe attributes the
         host-granularity straggler."""
         rep, self._last_slow = self._last_slow, None
+        return rep
+
+    # -- the persistent worker straggler (async bounded staleness) ---------
+    def slow_worker_spec(self, round_):
+        """(worker, extra_seconds) when the slow_worker injector is
+        active at ``round_``, else None — the NON-BLOCKING query the
+        async scheduler feeds to its virtual version clocks (the
+        straggler pays its seconds on its own clock, never on the
+        consensus's). Logs one ``slow_worker`` chaos event on first
+        activation."""
+        if self.slow_worker is None or round_ < self.slow_round \
+                or self.slow_s <= 0:
+            return None
+        if not self._slow_worker_logged:
+            self._slow_worker_logged = True
+            self._event("slow_worker", worker=self.slow_worker,
+                        round=round_, seconds=self.slow_s)
+        return (self.slow_worker, self.slow_s)
+
+    def maybe_slow_worker(self, round_):
+        """The SYNCHRONOUS rendering of slow_worker: the barrier waits,
+        so the whole round blocks for the straggler's extra seconds
+        (every round from slow_round on — a persistent straggler).
+        Returns the injected seconds; pop_slow_worker() reports the
+        attribution for the round-latency probe."""
+        spec = self.slow_worker_spec(round_)
+        if spec is None:
+            return 0.0
+        self._last_slow_worker = spec
+        time.sleep(spec[1])
+        return spec[1]
+
+    def pop_slow_worker(self):
+        """(worker, seconds) of the sync slow-worker stall since the
+        last call, or None."""
+        rep, self._last_slow_worker = self._last_slow_worker, None
         return rep

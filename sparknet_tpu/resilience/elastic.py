@@ -54,6 +54,23 @@ round, so membership changes cost zero recompiles; when an eviction is
 persistent, ``LocalSGDSolver.shrink_to_survivors()`` optionally rebuilds
 the mesh over the live devices (one recompile) so dead slots stop
 burning compute.
+
+Bounded staleness (the async local-SGD mode, ISSUE 7) generalizes the
+0/1 validity bit to a [0, 1] per-worker WEIGHT: a worker ``lag`` rounds
+behind the fastest live peer contributes with weight
+``staleness_discount(lag, s, decay)`` — exactly 1.0 at lag 0 (the
+bit-for-bit anchor: an s=0 async round IS the synchronous masked
+round), geometrically discounted while 0 < lag <= s, and excluded by
+the same where-mask as a dead worker once the bound is hit. The host
+half of the mode also lives here: ElasticPolicy tracks per-worker round
+versions on virtual clocks (a chaos ``slow_worker`` accrues its injected
+seconds instead of blocking the consensus), PARKS a worker whose lag
+crosses the bound (weight 0, still a member), readmits it after
+``unpark_after`` rounds by resyncing it onto the replicated consensus
+(the same free re-broadcast as eviction readmission), and optionally
+evicts a chronically-parked worker — stale and dead workers degrade
+through identical machinery, and progress never blocks on the slowest
+fault domain.
 """
 
 import numpy as np
@@ -162,6 +179,97 @@ def masked_consensus_stats(tree, valid, axis):
     return consensus, aux
 
 
+# -- bounded staleness (device half) ----------------------------------------
+
+def staleness_discount(lag, s, decay=0.5):
+    """Per-worker staleness weight in [0, 1] for the async bounded-
+    staleness consensus. ``lag``: rounds behind the fastest live peer
+    (f32 scalar or vector); ``s``: the staleness bound; ``decay``: the
+    geometric discount per round of lag.
+
+      lag == 0      -> EXACTLY 1.0f (the bit-for-bit anchor: with every
+                       lag zero the weighted average degenerates to the
+                       synchronous masked round, bit for bit)
+      0 < lag <= s  -> decay ** lag (strictly monotone in lag for
+                       decay < 1; decay=1 keeps all in-bound workers at
+                       full weight — pure bounded staleness, no discount)
+      lag > s       -> 0.0 (over-stale == dead to the consensus; the
+                       same where-mask excludes both)
+
+    Pure jnp, usable inside shard_map; lag arrives as a traced input so
+    staleness changes cost zero recompiles (like the alive mask)."""
+    import jax.numpy as jnp
+    lag = jnp.asarray(lag, jnp.float32)
+    w = jnp.where(lag <= 0, jnp.float32(1),
+                  jnp.float32(decay) ** lag)
+    return jnp.where(lag > jnp.float32(s), jnp.float32(0), w)
+
+
+def weighted_consensus(tree, weight, axis):
+    """masked_consensus generalized from a 0/1 validity bit to a [0, 1]
+    per-worker weight: consensus = sum_w weight_w * x_w / sum_w weight_w
+    across ``axis``, zero-weight workers excluded via ``jnp.where`` (so
+    their NaNs never reach the psum — identical discipline to the dead-
+    worker mask). Returns (consensus, weight_sum).
+
+    Bit-for-bit contract: with every weight EXACTLY 1.0 this is the
+    masked_consensus all-valid path bit for bit — ``x * 1.0f`` is
+    bitwise ``x`` for every IEEE value, the weight psum equals the live
+    count exactly (small ints exact in f32), and the renormalization
+    scale is exactly 1.0 — so an s=0 async round is THE synchronous
+    round, not a reimplementation that could round differently."""
+    import jax
+    import jax.numpy as jnp
+    from ..parallel.compat import axis_size
+    n = axis_size(axis)
+    weight = jnp.asarray(weight, jnp.float32)
+    wsum = jax.lax.psum(weight, axis)
+    # the 1e-6 floor only matters when EVERY weight is zero (the
+    # all-excluded round returns zeros either way); for any wsum >= one
+    # worker's weight the scale is exact
+    scale = jnp.float32(n) / jnp.maximum(wsum, jnp.float32(1e-6))
+    keep = weight > 0
+
+    def one(x):
+        x = jnp.asarray(x)
+        xw = jnp.where(keep, x * weight.astype(x.dtype),
+                       jnp.zeros_like(x))
+        m = jax.lax.pmean(xw, axis)
+        return m * scale.astype(m.dtype)
+
+    return jax.tree_util.tree_map(one, tree), wsum
+
+
+def weighted_consensus_stats(tree, valid, weight, axis):
+    """weighted_consensus + the divergence aux of masked_consensus_stats.
+    ``valid`` is the membership bit (alive AND device-finite — what the
+    ElasticPolicy consumes for eviction streaks; a parked-but-healthy
+    worker stays valid), ``weight`` the staleness-discounted consensus
+    weight (valid * staleness_discount(lag)). Drift statistics cover the
+    INCLUDED workers (weight > 0); the aux additionally gathers the
+    weight vector so the host can attribute drift to staleness."""
+    import jax
+    import jax.numpy as jnp
+    from ..obs.divergence import tree_sq_dist
+    consensus, wsum = weighted_consensus(tree, weight, axis)
+    included = (jnp.asarray(weight, jnp.float32) > 0)
+    inc_f32 = included.astype(jnp.float32)
+    per_layer, local_sq = tree_sq_dist(tree, consensus)
+    local_sq = jnp.where(included, local_sq, jnp.float32(0))
+    aux = {
+        "div_mean_sq": masked_scalar_mean(local_sq, inc_f32, axis),
+        "div_max_sq": jax.lax.pmax(local_sq, axis),
+        "div_worker_sq": jax.lax.all_gather(local_sq, axis),
+        "layer_div_sq": {k: masked_scalar_mean(v, inc_f32, axis)
+                         for k, v in per_layer.items()},
+        "valid": jax.lax.all_gather(jnp.asarray(valid, jnp.float32), axis),
+        "weight": jax.lax.all_gather(jnp.asarray(weight, jnp.float32),
+                                     axis),
+        "n_live": jax.lax.psum(inc_f32, axis),
+    }
+    return consensus, aux
+
+
 # -- host half -------------------------------------------------------------
 
 def expand_to_slots(shards, owners):
@@ -199,7 +307,8 @@ class ElasticPolicy:
 
     def __init__(self, n_workers, quorum=1, evict_after=2, readmit_after=5,
                  shrink_after=0, metrics=None, log_fn=print, chaos=None,
-                 unit="worker"):
+                 unit="worker", staleness=None, s_decay=0.5,
+                 unpark_after=1, evict_parked_after=0):
         self.n = int(n_workers)
         # membership granularity: "worker" (a mesh slot on the data
         # axis — PR 4) or "host" (a whole fault domain on the host axis
@@ -218,6 +327,20 @@ class ElasticPolicy:
         # >0: after this many consecutive rounds with ANY eviction in
         # force, suggest shrinking the mesh (the solver acts on it)
         self.shrink_after = max(0, int(shrink_after))
+        # bounded staleness (the async local-SGD mode): None = the
+        # synchronous policy; an int s >= 0 arms per-worker round-version
+        # tracking on virtual clocks — a worker more than s rounds
+        # behind the fastest live peer is PARKED (consensus weight 0,
+        # still a member), resynced onto the replicated consensus after
+        # ``unpark_after`` rounds, and (optionally) evicted after
+        # ``evict_parked_after`` parks without a sustained in-bound
+        # stretch in between (reason "staleness").
+        self.staleness = None if staleness is None else max(0, int(staleness))
+        self.s_decay = float(s_decay)
+        if not (0.0 < self.s_decay <= 1.0):
+            raise ValueError(f"s_decay {self.s_decay} must be in (0, 1]")
+        self.unpark_after = max(1, int(unpark_after))
+        self.evict_parked_after = max(0, int(evict_parked_after))
         self.metrics = metrics
         self.log = log_fn or (lambda *a: None)
         self.chaos = chaos
@@ -228,6 +351,17 @@ class ElasticPolicy:
         self._evicted_at = {}           # worker -> eviction round
         self._degraded_rounds = 0       # consecutive rounds not at full n
         self.quorum_lost = False
+        # async version accounting (all no-ops while staleness is None)
+        self.parked = np.zeros(self.n, bool)
+        self.version = np.zeros(self.n, np.int64)
+        self.park_rounds = np.zeros(self.n, np.int64)  # total parked time
+        self.parks = []                 # [{worker, round, lag}, ...]
+        self.unparks = []               # [{worker, round, parked_rounds}]
+        self._parked_at = {}            # worker -> park round
+        self._park_streak = np.zeros(self.n, np.int64)
+        self._inbound_streak = np.zeros(self.n, np.int64)
+        self._done_at = np.zeros(self.n, np.float64)   # virtual clocks
+        self._wall = 0.0
 
     # -- views -------------------------------------------------------------
     def live(self):
@@ -252,12 +386,44 @@ class ElasticPolicy:
         rank = {w: i for i, w in enumerate(live)}
         return [rank[int(w)] for w in owner_worker]
 
+    def lag(self):
+        """(n,) rounds each worker trails the fastest LIVE peer (0 with
+        the synchronous policy). Parked and evicted workers' versions
+        stop advancing, so their lag keeps growing until resync."""
+        if self.staleness is None:
+            return np.zeros(self.n, np.float64)
+        fastest = self.version[self.alive].max() if self.alive.any() else 0
+        return np.maximum(0, fastest - self.version).astype(np.float64)
+
+    def consensus_weights(self):
+        """(n,) f32 staleness-discounted consensus weight per worker —
+        the host-side twin of the device staleness_discount path, for
+        transports that average on the host (the async file relay).
+        All ones with the synchronous policy."""
+        w = self.alive_f32()
+        if self.staleness is None:
+            return w
+        lag = self.lag()
+        disc = np.where(lag <= 0, np.float32(1),
+                        np.float32(self.s_decay) ** lag.astype(np.float32))
+        disc = np.where(lag > self.staleness, np.float32(0), disc)
+        return (w * disc).astype(np.float32)
+
     def summary(self):
-        return {"world": self.n, "live": self.live_count(),
-                "quorum": self.quorum, "unit": self.unit,
-                "evictions": list(self.evictions),
-                "readmissions": list(self.readmissions),
-                "quorum_lost": self.quorum_lost}
+        out = {"world": self.n, "live": self.live_count(),
+               "quorum": self.quorum, "unit": self.unit,
+               "evictions": list(self.evictions),
+               "readmissions": list(self.readmissions),
+               "quorum_lost": self.quorum_lost}
+        if self.staleness is not None:
+            out.update(staleness=self.staleness,
+                       parks=len(self.parks), unparks=len(self.unparks),
+                       parked=[int(w) for w in np.nonzero(self.parked)[0]],
+                       park_rounds_by_worker={
+                           str(w): int(r) for w, r in
+                           enumerate(self.park_rounds) if r},
+                       max_lag=int(self.lag().max()))
+        return out
 
     # -- membership transitions --------------------------------------------
     def evict(self, worker, round_idx, reason):
@@ -269,6 +435,10 @@ class ElasticPolicy:
         self.alive[w] = False
         self._bad_streak[w] = 0
         self._evicted_at[w] = round_idx
+        if self.parked[w]:              # an evicted worker is no longer
+            self.parked[w] = False      # "parked" — dead outranks stale
+            r0 = self._parked_at.pop(w, round_idx)
+            self.park_rounds[w] += max(0, round_idx - r0)
         rec = {"worker": w, "round": round_idx, "reason": reason,
                "live": self.live_count(), "unit": self.unit}
         self.evictions.append(rec)
@@ -292,6 +462,12 @@ class ElasticPolicy:
         self.alive[w] = True
         self._bad_streak[w] = 0
         self._evicted_at.pop(w, None)
+        if self.staleness is not None:
+            # the replicated consensus IS the readmission re-broadcast:
+            # the worker rejoins at the front, lag 0
+            self.version[w] = self.version[self.alive].max()
+            self._done_at[w] = self._wall
+            self._park_streak[w] = 0
         rec = {"worker": w, "round": round_idx, "live": self.live_count(),
                "unit": self.unit}
         self.readmissions.append(rec)
@@ -301,6 +477,113 @@ class ElasticPolicy:
         if self.metrics is not None:
             self.metrics.log("readmission", **rec)
         return True
+
+    # -- bounded staleness: park / unpark / version clocks -------------------
+    def park(self, worker, round_idx, lag=None):
+        """Park a worker whose staleness bound was hit: consensus weight
+        0 (the same exclusion machinery as a dead worker) but it stays a
+        MEMBER — no quorum impact, and the unpark below is its
+        readmission. A ``parked`` metrics event records the transition;
+        ``evict_parked_after`` consecutive parks without a sustained
+        in-bound stretch escalate to a real eviction (reason
+        "staleness"), which CAN raise QuorumLost."""
+        w = int(worker)
+        if not (0 <= w < self.n) or not self.alive[w] or self.parked[w]:
+            return False
+        self.parked[w] = True
+        self._parked_at[w] = round_idx
+        self._park_streak[w] += 1
+        self._inbound_streak[w] = 0
+        rec = {"worker": w, "round": round_idx, "unit": self.unit,
+               "lag": None if lag is None else int(lag),
+               "streak": int(self._park_streak[w])}
+        self.parks.append(rec)
+        self.log(f"elastic: PARKED {self.unit} {w} at round {round_idx} "
+                 f"(lag {lag} > staleness bound {self.staleness}); "
+                 f"excluded from the consensus until resync")
+        if self.metrics is not None:
+            self.metrics.log("parked", **rec)
+        if self.evict_parked_after and \
+                self._park_streak[w] >= self.evict_parked_after:
+            return self.evict(w, round_idx, "staleness")
+        return True
+
+    def unpark(self, worker, round_idx):
+        """Readmit a parked worker: it adopts the replicated consensus
+        (every slot already holds it — the free re-broadcast) and
+        rejoins at the front with lag 0. Emits an ``unparked`` event
+        carrying the park duration (the park-time metric)."""
+        w = int(worker)
+        if not (0 <= w < self.n) or not self.parked[w]:
+            return False
+        self.parked[w] = False
+        r0 = self._parked_at.pop(w, round_idx)
+        dur = max(0, round_idx - r0)
+        self.park_rounds[w] += dur
+        self.version[w] = self.version[self.alive].max() \
+            if self.alive.any() else self.version[w]
+        self._done_at[w] = self._wall
+        rec = {"worker": w, "round": round_idx, "unit": self.unit,
+               "parked_rounds": int(dur),
+               "park_rounds_total": int(self.park_rounds[w])}
+        self.unparks.append(rec)
+        self.log(f"elastic: unparked {self.unit} {w} at round {round_idx} "
+                 f"after {dur} round(s), resynced from the consensus")
+        if self.metrics is not None:
+            self.metrics.log("unparked", **rec)
+        return True
+
+    def advance_versions(self, round_idx, round_s, slow=None):
+        """Advance the per-worker virtual clocks by one wall round of
+        ``round_s`` seconds. A healthy worker completes exactly one
+        local round per wall round; a straggler (``slow``: the chaos
+        ``slow_worker`` spec ``(worker, extra_s)``) pays ``extra_s``
+        more per local round, so it completes them at rate
+        round_s / (round_s + extra_s) and its version lag grows — the
+        consensus does NOT wait for it (that is the whole point), it
+        just discounts or excludes its contributions."""
+        if self.staleness is None:
+            return
+        dt = max(float(round_s), 1e-6)
+        self._wall += dt
+        extra = np.zeros(self.n, np.float64)
+        if slow is not None and slow[0] is not None \
+                and 0 <= int(slow[0]) < self.n:
+            extra[int(slow[0])] = max(0.0, float(slow[1]))
+        for w in range(self.n):
+            if not self.alive[w] or self.parked[w]:
+                # parked/dead workers aren't racing; they rejoin fresh
+                self._done_at[w] = self._wall
+                continue
+            cost = dt + extra[w]
+            while self._done_at[w] + cost <= self._wall + 1e-9:
+                self._done_at[w] += cost
+                self.version[w] += 1
+
+    def observe_staleness(self, round_idx):
+        """The per-round staleness controller (async mode only): unpark
+        workers whose cooldown elapsed (resync = readmission), then park
+        any live worker whose lag crossed the bound. Returns True when
+        park state changed (the next round's weights differ)."""
+        if self.staleness is None:
+            return False
+        changed = False
+        for w, r0 in sorted(self._parked_at.items()):
+            if round_idx - r0 >= self.unpark_after:
+                changed |= self.unpark(w, round_idx)
+        lag = self.lag()
+        for w in range(self.n):
+            if not self.alive[w] or self.parked[w]:
+                continue
+            if lag[w] > self.staleness:
+                changed |= self.park(w, round_idx, lag=lag[w])
+            else:
+                # a sustained in-bound stretch clears the park streak
+                # (the worker genuinely recovered, it isn't cycling)
+                self._inbound_streak[w] += 1
+                if self._inbound_streak[w] > self.unpark_after + 1:
+                    self._park_streak[w] = 0
+        return changed
 
     def _quorum_lost(self, round_idx, **fields):
         self.quorum_lost = True
@@ -367,6 +650,14 @@ class ElasticPolicy:
         self._bad_streak = np.zeros(self.n, np.int64)
         self._evicted_at = {}
         self._degraded_rounds = 0
+        self.parked = np.zeros(self.n, bool)
+        self.version = np.zeros(self.n, np.int64)
+        self.park_rounds = np.zeros(self.n, np.int64)
+        self._parked_at = {}
+        self._park_streak = np.zeros(self.n, np.int64)
+        self._inbound_streak = np.zeros(self.n, np.int64)
+        self._done_at = np.zeros(self.n, np.float64)
+        self._wall = 0.0
         if self.metrics is not None:
             self.metrics.log("membership", kind="world_reset",
                              live=self.n)

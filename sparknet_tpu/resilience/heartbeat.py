@@ -163,10 +163,62 @@ class HeartbeatCoordinator:
             self._round = int(round_idx)
         self.beat()
 
+    def _reap_ghosts(self):
+        """Startup GC: a previous run that crashed in the SAME rendezvous
+        directory leaves hb-*.json leases (and orphaned round files —
+        part/delta/mask/consensus/restart) behind. A ghost's stale lease
+        would count toward the pre-round gate and the quorum until its
+        (already expired) stamp is re-examined — worse, a ghost with a
+        FUTURE round number could satisfy gates it never attended. Reap
+        every lease whose stamp is already older than lease_s at startup
+        and every orphaned round file with an mtime that old, and emit
+        one ``ghost_reaped`` metrics event naming them. Fresh files from
+        live peers of THIS run are untouched (they re-lease every
+        interval_s, so their stamps are never near the lease)."""
+        now = time.time()
+        ghost_hosts, orphans = [], 0
+        for p in glob.glob(os.path.join(glob.escape(self.dir), "hb-*.json")):
+            rec = _read_json(p)
+            stamp = float(rec.get("stamp", 0.0)) \
+                if rec is not None else 0.0
+            if now - stamp <= self.lease_s:
+                continue
+            try:
+                os.remove(p)
+            except OSError:
+                continue        # a concurrent peer reaped it first
+            ghost_hosts.append(rec.get("host") if rec is not None
+                               else os.path.basename(p))
+        for pat in ("part-*.npz", "mask-*.json", "delta-*.npz",
+                    "delta-*.json", "consensus-*.npz", "consensus-*.json",
+                    "restart-*.json"):
+            for p in glob.glob(os.path.join(glob.escape(self.dir), pat)):
+                try:
+                    if now - os.path.getmtime(p) <= self.lease_s:
+                        continue
+                    os.remove(p)
+                    orphans += 1
+                except OSError:
+                    pass
+        if ghost_hosts or orphans:
+            self.log(f"heartbeat: reaped {len(ghost_hosts)} ghost "
+                     f"lease(s) {sorted(map(str, ghost_hosts))} and "
+                     f"{orphans} orphaned round file(s) left by a "
+                     "previous run in this rendezvous dir")
+            if self.metrics is not None:
+                self.metrics.log("ghost_reaped",
+                                 hosts=sorted(map(str, ghost_hosts)),
+                                 orphaned_files=orphans,
+                                 observer=self.host)
+
     def start(self):
-        """First beat + the background re-leaser. Idempotent."""
+        """First beat + the background re-leaser. Idempotent. Reaps
+        ghost leases/round files from a previous run in the same
+        rendezvous dir BEFORE the first beat, so ghosts never count
+        toward the gate or the quorum."""
         if self._thread is not None:
             return self
+        self._reap_ghosts()
         self.beat()
         self._refresh_view()
         self._thread = threading.Thread(target=self._run, daemon=True,
@@ -505,6 +557,279 @@ class FileConsensus:
                "transport": "relay"}
         self._gc(round_idx)
         return consensus, aux
+
+
+# -- bounded-staleness async consensus over the rendezvous dir ---------------
+
+class AsyncFileConsensus(FileConsensus):
+    """Versioned, BARRIER-FREE cross-host delta exchange — the async
+    bounded-staleness rendering of FileConsensus (ISSUE 7). Where the
+    synchronous relay's authority WAITS for every live host's part file
+    before publishing the round mask, this one never waits for anyone:
+
+      1. after each local round a host atomically posts
+         ``delta-<host>-<v>.npz`` (payload) + ``delta-<host>-<v>.json``
+         (meta: host, version, valid, loss, stamp) at ITS OWN version
+         counter v — a slow host simply posts lower versions
+      2. the LOWEST-live-host merge authority publishes
+         ``consensus-<v*>`` at the fastest version it can see, averaging
+         each live host's LATEST delta with weight decay**(v* - v_h);
+         deltas more than ``s`` versions behind (and lease-expired
+         hosts) are excluded — the same degradation as death
+      3. every host adopts the newest published consensus it hasn't
+         adopted yet, or keeps its own weights when none is visible yet
+         (early rounds, a dead authority mid-failover) — it NEVER blocks
+      4. a host that finds itself more than ``s`` versions behind the
+         fastest live peer PARKS: it abandons its stale line, adopts the
+         latest consensus, and jumps its version to the front (the
+         relay twin of ElasticPolicy.park/unpark)
+
+    GC is lease-driven: a host whose lease expired has ALL its delta
+    files removed (its stale pushes must stop haunting merges), and
+    superseded delta/consensus versions are trimmed to a keep window.
+    s=0 with every host in step degenerates to one full-weight merge
+    per version — the synchronous consensus, reached without a barrier.
+    """
+
+    def __init__(self, coord, s=0, decay=0.5, keep_versions=3):
+        super().__init__(coord)
+        self.s = max(0, int(s))
+        self.decay = float(decay)
+        self.keep_versions = max(2, int(keep_versions))
+        self.version = 0            # this host's completed-round counter
+        self.parks = 0
+        self._adopted = -1          # newest consensus version adopted
+
+    # -- files ---------------------------------------------------------------
+    def _delta_npz(self, host, v):
+        return os.path.join(self.dir, f"delta-{int(host)}-{int(v)}.npz")
+
+    def _delta_meta(self, host, v):
+        return os.path.join(self.dir, f"delta-{int(host)}-{int(v)}.json")
+
+    def _consensus_npz(self, v):
+        return os.path.join(self.dir, f"consensus-{int(v)}.npz")
+
+    def _consensus_meta(self, v):
+        return os.path.join(self.dir, f"consensus-{int(v)}.json")
+
+    def _push(self, v, leaves, valid, loss):
+        """Payload first, meta last — the meta's atomic rename commits
+        the delta, so a reader that sees the meta can read the npz."""
+        path = self._delta_npz(self.coord.host, v)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "wb") as f:
+            np.savez(f, **{f"leaf{i}": np.asarray(a)
+                           for i, a in enumerate(leaves)})
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        _atomic_write_json(self._delta_meta(self.coord.host, v),
+                           {"host": self.coord.host, "version": int(v),
+                            "valid": int(bool(valid)),
+                            "loss": float(loss), "stamp": time.time()})
+
+    def _peer_versions(self):
+        """{host: newest committed delta version} from the meta files."""
+        vers = {}
+        for p in glob.glob(os.path.join(glob.escape(self.dir),
+                                        "delta-*.json")):
+            rec = _read_json(p)
+            if rec is None or not isinstance(rec.get("host"), int):
+                continue
+            h, v = rec["host"], int(rec.get("version", -1))
+            if v > vers.get(h, -1):
+                vers[h] = v
+        return vers
+
+    def _load_delta(self, host, v, n_leaves):
+        meta = _read_json(self._delta_meta(host, v))
+        if meta is None:
+            return None, None
+        try:
+            with np.load(self._delta_npz(host, v)) as z:
+                leaves = [z[f"leaf{i}"] for i in range(n_leaves)]
+        except (OSError, ValueError, KeyError):
+            return None, None
+        return leaves, meta
+
+    # -- the merge authority -------------------------------------------------
+    def _merge(self, v_ref, live, vers, n_leaves):
+        """Publish consensus-<v_ref> from each live host's latest delta
+        within the staleness bound, discounted by decay**lag. Runs on
+        the lowest live host; failover is automatic (the next-lowest
+        live host sees itself lowest once the lease expires). Idempotent
+        per v_ref — an existing consensus file is left alone."""
+        if _read_json(self._consensus_meta(v_ref)) is not None:
+            return
+        included, acc, wsum = [], None, 0.0
+        parts = {}
+        for h in sorted(live):
+            vh = vers.get(h, -1)
+            if vh < 0 or v_ref - vh > self.s:
+                continue                    # over-stale == excluded
+            leaves, meta = self._load_delta(h, vh, n_leaves)
+            if leaves is None or not meta.get("valid"):
+                continue                    # torn or non-finite: out
+            lagh = max(0, v_ref - vh)
+            w = 1.0 if lagh == 0 else self.decay ** lagh
+            parts[h] = (leaves, meta, lagh, w)
+            wsum += w
+        if not parts:
+            return                          # nothing mergeable yet
+        consensus = []
+        for i in range(n_leaves):
+            a = None
+            for h, (leaves, _, _, w) in parts.items():
+                x = np.asarray(leaves[i], np.float64) * (w / wsum)
+                a = x if a is None else a + x
+            consensus.append(a)
+        for h, (leaves, meta, lagh, w) in sorted(parts.items()):
+            div = sum(float(((np.asarray(leaves[i], np.float64)
+                              - consensus[i]) ** 2).sum())
+                      for i in range(n_leaves))
+            included.append({"host": h, "version": int(vers[h]),
+                             "lag": int(lagh), "weight": round(w, 6),
+                             "loss": float(meta.get("loss",
+                                                    float("nan"))),
+                             "div_sq": div})
+        path = self._consensus_npz(v_ref)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "wb") as f:
+            np.savez(f, **{f"leaf{i}": c.astype(np.float64)
+                           for i, c in enumerate(consensus)})
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        _atomic_write_json(self._consensus_meta(v_ref),
+                           {"version": int(v_ref),
+                            "authority": self.coord.host,
+                            "included": included,
+                            "stamp": time.time()})
+
+    def _latest_consensus(self, n_leaves):
+        """(version, leaves, meta) of the newest committed consensus,
+        or (None,)*3 — purely a read, never a wait."""
+        best = None
+        for p in glob.glob(os.path.join(glob.escape(self.dir),
+                                        "consensus-*.json")):
+            rec = _read_json(p)
+            if rec is not None and isinstance(rec.get("version"), int):
+                if best is None or rec["version"] > best["version"]:
+                    best = rec
+        if best is None:
+            return None, None, None
+        try:
+            with np.load(self._consensus_npz(best["version"])) as z:
+                leaves = [z[f"leaf{i}"] for i in range(n_leaves)]
+        except (OSError, ValueError, KeyError):
+            return None, None, None
+        return best["version"], leaves, best
+
+    def _gc_async(self, vers, live):
+        """Lease-expiry GC: every delta of a host whose lease expired is
+        removed (its stale pushes must stop haunting merges), and
+        committed versions older than the keep window are trimmed."""
+        floor = max(vers.values(), default=0) - self.s - self.keep_versions
+        for p in glob.glob(os.path.join(glob.escape(self.dir),
+                                        "delta-*.json")):
+            rec = _read_json(p)
+            if rec is None:
+                continue
+            h, v = rec.get("host"), int(rec.get("version", -1))
+            dead = isinstance(h, int) and h not in live
+            if dead or v < floor:
+                for path in (p, self._delta_npz(h, v)):
+                    try:
+                        os.remove(path)
+                    except OSError:
+                        pass
+        keep = self.keep_versions
+        cons = sorted(int(p.rsplit("-", 1)[1].split(".")[0])
+                      for p in glob.glob(os.path.join(
+                          glob.escape(self.dir), "consensus-*.json"))
+                      if p.rsplit("-", 1)[1].split(".")[0].isdigit())
+        for v in cons[:-keep] if len(cons) > keep else []:
+            for path in (self._consensus_npz(v), self._consensus_meta(v)):
+                try:
+                    os.remove(path)
+                except OSError:
+                    pass
+
+    # -- the exchange --------------------------------------------------------
+    def exchange(self, round_idx, leaves, valid, loss, alive_hosts,
+                 timeout=None):
+        """One barrier-free exchange (same signature as the synchronous
+        FileConsensus so LocalSGDSolver._train_round_relay is transport-
+        agnostic; ``round_idx``/``timeout`` are accepted but versioning
+        is internal and nothing ever waits). Returns (consensus_leaves,
+        aux) with the same aux fields plus ``lag`` (per-host version
+        lag), ``parked_self`` and ``version``."""
+        me = self.coord.host
+        n = self.coord.n
+        v = self.version
+        self._push(v, leaves, valid, loss)
+        vers = self._peer_versions()
+        vers[me] = max(vers.get(me, -1), v)
+        live = set(int(h) for h in alive_hosts) | {me}
+        live &= set(self.coord.alive_hosts()) | {me}
+        fastest = max((vers.get(h, -1) for h in live), default=v)
+        my_lag = max(0, fastest - v)
+        if me == min(live):
+            self._merge(fastest, live, vers, len(leaves))
+        cv, cleaves, cmeta = self._latest_consensus(len(leaves))
+        parked = my_lag > self.s
+        if parked:
+            # the bound is hit: abandon the stale line, adopt the
+            # consensus, rejoin at the front (the relay park/unpark)
+            self.parks += 1
+            self.coord.log(
+                f"async relay: host {me} PARKED at version {v} "
+                f"(lag {my_lag} > s={self.s}); resyncing to the front")
+            if self.coord.metrics is not None:
+                self.coord.metrics.log("parked", worker=me, unit="host",
+                                       round=int(v), lag=int(my_lag))
+            self.version = fastest          # resynced
+        else:
+            self.version = v + 1
+        if cleaves is not None and cv > self._adopted:
+            self._adopted = cv
+            out = [c.astype(np.asarray(leaves[i]).dtype)
+                   for i, c in enumerate(cleaves)]
+            meta_inc = {e["host"]: e for e in cmeta.get("included", [])}
+        else:
+            # no (new) consensus visible — keep our own post-round
+            # weights and keep moving; the next exchange will adopt
+            out = [np.asarray(x) for x in leaves]
+            meta_inc = {me: {"host": me, "version": v, "lag": 0,
+                             "weight": 1.0, "loss": float(loss),
+                             "div_sq": 0.0}}
+        valid_vec = np.zeros(n, np.float32)
+        weight_vec = np.zeros(n, np.float32)
+        loss_vec = np.full(n, np.nan, np.float32)
+        div_sq = np.zeros(n, np.float32)
+        lag_vec = np.zeros(n, np.float32)
+        for h in range(n):
+            lag_vec[h] = max(0, fastest - vers.get(h, fastest))
+            e = meta_inc.get(h)
+            if e is not None:
+                valid_vec[h] = 1.0
+                weight_vec[h] = float(e.get("weight", 1.0))
+                loss_vec[h] = e.get("loss", float("nan"))
+                div_sq[h] = e.get("div_sq", 0.0)
+        live_div = div_sq[valid_vec > 0] if (valid_vec > 0).any() \
+            else np.zeros(1, np.float32)
+        aux = {"valid": valid_vec, "weight": weight_vec,
+               "n_live": np.float32((valid_vec > 0).sum()),
+               "worker_loss": loss_vec, "div_worker_sq": div_sq,
+               "div_mean_sq": np.float32(live_div.mean()),
+               "div_max_sq": np.float32(live_div.max()),
+               "lag": [int(x) for x in lag_vec],
+               "parked": [me] if parked else [],
+               "parked_self": parked, "version": int(self.version),
+               "transport": "async-relay"}
+        self._gc_async(vers, live)
+        return out, aux
 
 
 # -- coordinated restart -----------------------------------------------------

@@ -111,6 +111,13 @@ class Solver:
         self.snapshot_keep = None
         self.recovery = None
         self.elastic = None
+        # bounded-staleness async mode (resilience/elastic.py, ISSUE 7):
+        # None = synchronous rounds; an int s >= 0 (arm_staleness) makes
+        # the sharded consensus a staleness-weighted average — workers
+        # push versioned contributions, stale ones are discounted, over-
+        # stale ones are parked, and the round never waits on a straggler
+        self.staleness = None
+        self.s_decay = 0.5
         # host-level fault domains (resilience/heartbeat.py), armed via
         # arm_heartbeat(): leased liveness for every peer process, the
         # pre-round rendezvous gate, and the coordinated-restart barrier
@@ -399,12 +406,59 @@ class Solver:
             kw.setdefault("log_fn", self.log)
             kw.setdefault("chaos", self.chaos)
             kw.setdefault("unit", getattr(self, "elastic_unit", "worker"))
+            kw.setdefault("staleness", self.staleness)
+            kw.setdefault("s_decay", self.s_decay)
             policy = ElasticPolicy(n_workers=n, **kw)
         self.elastic = policy
         self._jit_train = None
         if hasattr(self, "_jit_round"):
             self._jit_round = None
         return policy
+
+    def arm_staleness(self, s, decay=0.5, unpark_after=1,
+                      evict_parked_after=0):
+        """Arm the asynchronous bounded-staleness update mode (`--
+        staleness` next to `--tau`): the sharded consensus becomes a
+        staleness-weighted average (resilience/elastic.py
+        weighted_consensus) over versioned worker contributions — a
+        worker ``lag`` rounds behind the fastest live peer is discounted
+        by ``decay ** lag``, parked (weight 0, still a member) once
+        ``lag > s``, resynced from the replicated consensus after
+        ``unpark_after`` rounds, and evicted after
+        ``evict_parked_after`` chronic parks (0 = never). s=0 is
+        BIT-FOR-BIT the synchronous masked round. Arms elastic
+        membership implicitly (quorum 1) when none is armed yet; the
+        async file relay (heartbeat.AsyncFileConsensus) is upgraded in
+        place when a synchronous relay was already armed."""
+        self.staleness = max(0, int(s))
+        self.s_decay = float(decay)
+        if self.elastic is None:
+            self.arm_elastic(quorum=1, unpark_after=unpark_after,
+                             evict_parked_after=evict_parked_after)
+        else:
+            el = self.elastic
+            el.staleness = self.staleness
+            el.s_decay = self.s_decay
+            el.unpark_after = max(1, int(unpark_after))
+            el.evict_parked_after = max(0, int(evict_parked_after))
+        if getattr(self, "_relay", None) is not None:
+            from ..resilience.heartbeat import (AsyncFileConsensus,
+                                                FileConsensus)
+            if type(self._relay) is FileConsensus:
+                self._relay = AsyncFileConsensus(
+                    self._relay.coord, s=self.staleness,
+                    decay=self.s_decay)
+                self.log("staleness: upgraded the cross-host relay to "
+                         "the versioned barrier-free delta exchange")
+            elif isinstance(self._relay, AsyncFileConsensus):
+                self._relay.s = self.staleness
+                self._relay.decay = self.s_decay
+        self._jit_train = None
+        if hasattr(self, "_jit_round"):
+            self._jit_round = None
+        self.log(f"staleness: async bounded-staleness armed (s="
+                 f"{self.staleness}, decay={self.s_decay})")
+        return self.elastic
 
     def arm_heartbeat(self, directory, interval_s=0.5, lease_s=3.0,
                       relay="auto", **kw):
@@ -435,9 +489,16 @@ class Solver:
             from ..parallel.multihost import needs_host_relay
             relay = needs_host_relay()
         if relay and hasattr(self, "_train_round_relay"):
-            self._relay = FileConsensus(coord)
-            self.log(f"heartbeat: relay consensus armed ({n} hosts "
-                     "through the rendezvous directory)")
+            if self.staleness is not None:
+                from ..resilience.heartbeat import AsyncFileConsensus
+                self._relay = AsyncFileConsensus(coord, s=self.staleness,
+                                                 decay=self.s_decay)
+                self.log(f"heartbeat: ASYNC relay consensus armed ({n} "
+                         "hosts, versioned barrier-free delta exchange)")
+            else:
+                self._relay = FileConsensus(coord)
+                self.log(f"heartbeat: relay consensus armed ({n} hosts "
+                         "through the rendezvous directory)")
         if self.elastic is not None and self.elastic.n != n and \
                 getattr(self, "_relay", None) is not None:
             self.log(f"heartbeat: WARNING — elastic world {self.elastic.n}"
@@ -471,6 +532,19 @@ class Solver:
         if self.elastic is not None and self.elastic.n == n:
             return jnp.asarray(self.elastic.alive_f32())
         return jnp.ones((n,), jnp.float32)
+
+    def _staleness_lag(self):
+        """The (n,) f32 per-worker version-lag vector the async compiled
+        round consumes next to the alive mask — all zeros while the mode
+        is off (which keeps the staleness weights exactly 1.0, the
+        bit-for-bit anchor) or when the policy world spans processes
+        (relay mode applies staleness host-side at the exchange)."""
+        axis = getattr(self, "elastic_axis", None) or self.axis
+        n = self.mesh.shape[axis]
+        if self.staleness is not None and self.elastic is not None \
+                and self.elastic.n == n:
+            return jnp.asarray(self.elastic.lag(), jnp.float32)
+        return jnp.zeros((n,), jnp.float32)
 
     def _observe_membership(self, aux, round_idx=None):
         """Feed the elastic membership controller one materialized
@@ -601,7 +675,9 @@ class Solver:
                     latencies=self._round_latencies(round_s)
                     if round_s is not None else None,
                     divergence=d, valid=aux.get("valid"),
-                    alive=alive_during_round)
+                    alive=alive_during_round,
+                    lag=aux.get("lag"), parked=aux.get("parked"),
+                    staleness=self.staleness)
             return d
         except Exception as e:          # monitoring must never kill a run
             self.log(f"divergence observation failed: {e!r}")

@@ -1,0 +1,688 @@
+"""Bounded-staleness async local SGD tests (ISSUE 7).
+
+The contract under test: `--staleness s` next to `--tau` makes rounds
+barrier-free. The collect & average becomes a staleness-weighted
+consensus (resilience/elastic.py weighted_consensus) over versioned
+worker contributions — s=0 is BIT-FOR-BIT the synchronous masked round
+(the acceptance criterion), a worker past the bound is PARKED and
+READMITTED through the same mask machinery that handles death, a chaos
+``slow_worker``'s injected seconds land on its own virtual clock (round
+latency tracks the median worker, never the max), the cross-host relay
+becomes a versioned barrier-free delta exchange, ghost leases from a
+crashed previous run are reaped at startup, and malformed chaos specs /
+zero-event report selections fail loudly instead of passing vacuously.
+"""
+
+import io
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from sparknet_tpu.proto import Message
+from sparknet_tpu.utils.metrics import MetricsLogger
+from sparknet_tpu.parallel import (LocalSGDSolver, DataParallelSolver,
+                                   make_mesh)
+from sparknet_tpu.parallel.compat import shard_map
+from sparknet_tpu.resilience import ChaosMonkey
+from sparknet_tpu.resilience.elastic import (
+    ElasticPolicy, QuorumLost, masked_consensus, staleness_discount,
+    weighted_consensus, weighted_consensus_stats)
+from sparknet_tpu.resilience.heartbeat import (
+    HeartbeatCoordinator, AsyncFileConsensus, _atomic_write_json)
+
+
+def events_of(buf):
+    return [json.loads(line) for line in buf.getvalue().splitlines()]
+
+
+def sink():
+    buf = io.StringIO()
+    return MetricsLogger(stream=buf), buf
+
+
+def mlp_net(batch=8, dim=16, classes=4):
+    net = Message("NetParameter", name="mlp")
+    net.add("layer", name="d", type="JavaData", top=["data"],
+            java_data_param=dict(shape=dict(dim=[batch, dim])))
+    net.add("layer", name="l", type="JavaData", top=["label"],
+            java_data_param=dict(shape=dict(dim=[batch])))
+    net.add("layer", name="fc", type="InnerProduct", bottom=["data"],
+            top=["fc"], inner_product_param=dict(
+                num_output=classes, weight_filler=dict(type="xavier")))
+    net.add("layer", name="loss", type="SoftmaxWithLoss",
+            bottom=["fc", "label"], top=["loss"])
+    return net
+
+
+def lsgd(workers=4, tau=2, metrics=None, batch=8, **kw):
+    sp = Message("SolverParameter", base_lr=0.05, lr_policy="fixed",
+                 random_seed=0, display=0)
+    return LocalSGDSolver(sp, net_param=mlp_net(batch=batch),
+                          metrics=metrics, mesh=make_mesh({"data": workers}),
+                          tau=tau, log_fn=None, **kw)
+
+
+def round_batches(tau=2, workers=4, batch=8, seed=0):
+    rs = np.random.RandomState(seed)
+    return {"data": rs.randn(tau, workers * batch, 16).astype(np.float32),
+            "label": rs.randint(0, 4, (tau, workers * batch))
+            .astype(np.int32)}
+
+
+def tree_bytes_equal(a, b):
+    for lname in a:
+        for i, x in enumerate(a[lname]):
+            assert np.asarray(x).tobytes() == \
+                np.asarray(b[lname][i]).tobytes(), lname
+
+
+def _coord(tmp_path, host, n, lease=1.0, interval=0.1, metrics=None):
+    return HeartbeatCoordinator(str(tmp_path), host=host, n_hosts=n,
+                                interval_s=interval, lease_s=lease,
+                                metrics=metrics, log_fn=None)
+
+
+# ----------------------------------------- device half: the weight math ----
+
+class TestStalenessDiscount:
+    def test_lag_zero_is_exactly_one(self):
+        w = np.asarray(staleness_discount(np.zeros(4, np.float32), 3, 0.5))
+        assert w.tobytes() == np.ones(4, np.float32).tobytes()
+
+    def test_monotone_in_lag(self):
+        """The acceptance-criterion monotone-discounting property: the
+        weight strictly decreases as lag grows (decay < 1), then hits
+        exactly 0 past the bound."""
+        lags = np.arange(6, dtype=np.float32)
+        w = np.asarray(staleness_discount(lags, 3, 0.5))
+        assert all(w[i] > w[i + 1] for i in range(3)), w
+        np.testing.assert_allclose(w[:4], [1.0, 0.5, 0.25, 0.125])
+        assert w[4] == 0.0 and w[5] == 0.0
+
+    def test_decay_one_is_pure_bounded_staleness(self):
+        w = np.asarray(staleness_discount(
+            np.asarray([0, 1, 2, 3], np.float32), 2, 1.0))
+        np.testing.assert_array_equal(w, [1.0, 1.0, 1.0, 0.0])
+
+
+class TestWeightedConsensus:
+    @pytest.mark.parametrize("n", [2, 3, 4, 5, 8])
+    def test_all_ones_weight_is_bitwise_masked_consensus(self, n):
+        """s=0's device-level half: with every weight exactly 1.0 the
+        weighted average IS the masked (and plain pmean) path bit for
+        bit — including world sizes whose 1/n is inexact in f32."""
+        mesh = make_mesh({"data": n})
+        rs = np.random.RandomState(1)
+        tree = {"fc": [rs.randn(n, 4, 3).astype(np.float32)]}
+
+        def f(t, ones):
+            w = jax.lax.axis_index("data")
+            weighted, wsum = weighted_consensus(t, ones[w], "data")
+            masked, _ = masked_consensus(t, ones[w], "data")
+            return weighted, masked, jax.lax.pmean(t, "data"), wsum
+
+        g = jax.jit(shard_map(
+            f, mesh=mesh, in_specs=({"fc": [P("data")]}, P()),
+            out_specs=(P(),) * 4, check_vma=False))
+        weighted, masked, plain, wsum = g(tree, jnp.ones(n, jnp.float32))
+        assert np.asarray(weighted["fc"][0]).tobytes() == \
+            np.asarray(masked["fc"][0]).tobytes()
+        assert np.asarray(weighted["fc"][0]).tobytes() == \
+            np.asarray(plain["fc"][0]).tobytes()
+        assert float(wsum) == n
+
+    def test_fractional_weights_average_correctly(self):
+        n = 4
+        mesh = make_mesh({"data": n})
+        vals = np.asarray([0.0, 4.0, 8.0, 16.0], np.float32)
+        tree = {"fc": [vals.reshape(n, 1)]}
+        weights = np.asarray([1.0, 0.5, 0.25, 0.0], np.float32)
+
+        def f(t, wts):
+            w = jax.lax.axis_index("data")
+            return weighted_consensus(t, wts[w], "data")
+
+        g = jax.jit(shard_map(
+            f, mesh=mesh, in_specs=({"fc": [P("data")]}, P()),
+            out_specs=(P(), P()), check_vma=False))
+        c, wsum = g(tree, jnp.asarray(weights))
+        want = (vals * weights).sum() / weights.sum()
+        np.testing.assert_allclose(np.asarray(c["fc"][0]), want,
+                                   rtol=1e-6)
+        assert float(wsum) == pytest.approx(1.75)
+
+    def test_over_stale_worker_excluded_even_with_nan(self):
+        """The over-stale-exclusion acceptance item: weight 0 excludes
+        via the where-mask, so even a NaN'd over-stale replica cannot
+        poison the consensus (NaN * 0 would still be NaN)."""
+        n = 4
+        mesh = make_mesh({"data": n})
+        tree = {"fc": [np.ones((n, 2), np.float32)]}
+        tree["fc"][0][2, :] = np.nan           # the over-stale worker
+        lag = np.asarray([0.0, 1.0, 9.0, 0.0], np.float32)
+
+        def f(t, lags):
+            w = jax.lax.axis_index("data")
+            sw = staleness_discount(lags[w], 2, 0.5)
+            return weighted_consensus(t, sw, "data")
+
+        g = jax.jit(shard_map(
+            f, mesh=mesh, in_specs=({"fc": [P("data")]}, P()),
+            out_specs=(P(), P()), check_vma=False))
+        c, wsum = g(tree, jnp.asarray(lag))
+        assert np.isfinite(np.asarray(c["fc"][0])).all()
+        np.testing.assert_allclose(np.asarray(c["fc"][0]), 1.0)
+        assert float(wsum) == pytest.approx(2.5)  # 1 + 0.5 + 0 + 1
+
+    def test_monotone_discounting_shrinks_stale_influence(self):
+        """As a worker's lag grows, its pull on the consensus must
+        shrink monotonically — the property that makes bounded
+        staleness degrade gracefully instead of cliffing."""
+        n = 4
+        mesh = make_mesh({"data": n})
+        vals = np.zeros((n, 1), np.float32)
+        vals[1] = 100.0                         # the outlier/stale worker
+        tree = {"fc": [vals]}
+
+        def f(t, lags):
+            w = jax.lax.axis_index("data")
+            sw = staleness_discount(lags[w], 3, 0.5)
+            return weighted_consensus(t, sw, "data")
+
+        g = jax.jit(shard_map(
+            f, mesh=mesh, in_specs=({"fc": [P("data")]}, P()),
+            out_specs=(P(), P()), check_vma=False))
+        pulls = []
+        for lag1 in range(5):
+            lags = np.zeros(n, np.float32)
+            lags[1] = lag1
+            c, _ = g(tree, jnp.asarray(lags))
+            pulls.append(float(np.asarray(c["fc"][0]).ravel()[0]))
+        assert all(pulls[i] > pulls[i + 1] for i in range(3)), pulls
+        assert pulls[4] == 0.0                  # past the bound: no pull
+
+    def test_stats_report_weights_and_membership(self):
+        n = 4
+        mesh = make_mesh({"data": n})
+        rs = np.random.RandomState(0)
+        tree = {"fc": [rs.randn(n, 3).astype(np.float32)]}
+        lag = np.asarray([0.0, 1.0, 9.0, 0.0], np.float32)
+
+        def f(t, lags):
+            w = jax.lax.axis_index("data")
+            sw = staleness_discount(lags[w], 2, 0.5)
+            return weighted_consensus_stats(t, jnp.float32(1), sw, "data")
+
+        g = jax.jit(shard_map(
+            f, mesh=mesh, in_specs=({"fc": [P("data")]}, P()),
+            out_specs=(P(), P()), check_vma=False))
+        _, aux = g(tree, jnp.asarray(lag))
+        np.testing.assert_allclose(np.asarray(aux["weight"]).ravel(),
+                                   [1.0, 0.5, 0.0, 1.0])
+        assert float(aux["n_live"]) == 3        # the parked one is out
+        per = np.asarray(aux["div_worker_sq"]).ravel()
+        assert per[2] == 0.0 and np.isfinite(per).all()
+
+
+# --------------------------------------------- e2e: the async solver ----
+
+class TestAsyncLocalSGD:
+    def test_s0_round_bitwise_equals_synchronous_masked_round(self):
+        """THE acceptance criterion: an s=0 async round is bit-for-bit
+        the synchronous masked round, across multiple rounds."""
+        rounds = [round_batches(seed=s) for s in range(3)]
+        sync = lsgd()
+        sync.arm_elastic(quorum=1)
+        for b in rounds:
+            sync.train_round({k: v.copy() for k, v in b.items()})
+        a0 = lsgd(staleness=0)
+        for b in rounds:
+            a0.train_round({k: v.copy() for k, v in b.items()})
+        tree_bytes_equal(sync.params, a0.params)
+
+    def test_healthy_async_run_is_bitwise_synchronous(self):
+        """With no straggler every lag stays 0, so even s>0 changes
+        NOTHING — arming the async mode on a healthy run is free."""
+        rounds = [round_batches(seed=s) for s in range(3)]
+        sync = lsgd()
+        sync.arm_elastic(quorum=1)
+        for b in rounds:
+            sync.train_round({k: v.copy() for k, v in b.items()})
+        a2 = lsgd(staleness=2)
+        for b in rounds:
+            a2.train_round({k: v.copy() for k, v in b.items()})
+        assert not a2.elastic.parks
+        tree_bytes_equal(sync.params, a2.params)
+
+    def test_straggler_parked_and_readmitted_with_events(self):
+        """The satellite regression: a chaos slow_worker under the async
+        mode is parked when its lag crosses the bound and readmitted by
+        resync, with ``parked``/``unparked`` membership events naming
+        it — and it is NEVER evicted (parking is not death)."""
+        ms, buf = sink()
+        s = lsgd(metrics=ms, staleness=1)
+        s.chaos = ChaosMonkey(slow_worker=1, slow_s=60.0, log_fn=None,
+                              metrics=ms)
+        for r in range(8):
+            loss = s.train_round(round_batches(seed=r))
+        assert np.isfinite(float(loss))
+        for plist in s.params.values():
+            for p in plist:
+                assert np.isfinite(np.asarray(p)).all()
+        el = s.elastic
+        assert len(el.parks) >= 2 and len(el.unparks) >= 1
+        assert not el.evictions and el.live_count() == 4
+        s.close()
+        evs = events_of(buf)
+        parked = [e for e in evs if e["event"] == "parked"]
+        unparked = [e for e in evs if e["event"] == "unparked"]
+        assert parked and all(e["worker"] == 1 for e in parked)
+        assert unparked and all(e["worker"] == 1 for e in unparked)
+        assert all(e["parked_rounds"] >= 1 for e in unparked)
+        st = [e for e in evs if e["event"] == "staleness"]
+        assert st and any(max(e["lag"]) >= 2 for e in st)
+        # drift attribution rides the divergence events
+        div = [e for e in evs if e["event"] == "divergence"]
+        assert any("lag" in e for e in div)
+        assert any(e.get("drift_cause") in
+                   ("staleness", "membership", "tau") for e in div)
+
+    def test_async_round_latency_tracks_median_not_straggler(self):
+        """The wall-clock acceptance item, deterministically: the
+        straggler's injected seconds land on its virtual clock, not the
+        host loop — N rounds complete in far less than N * slow_s,
+        while the synchronous barrier provably sleeps slow_s per round."""
+        slow_s = 2.0
+        a = lsgd(staleness=1)
+        a.chaos = ChaosMonkey(slow_worker=1, slow_s=slow_s, log_fn=None)
+        a.train_round(round_batches(seed=0))    # warm-up (compile)
+        t0 = time.perf_counter()
+        for r in range(1, 5):
+            a.train_round(round_batches(seed=r))
+        async_wall = time.perf_counter() - t0
+        assert async_wall < 4 * slow_s * 0.5, \
+            f"async rounds blocked on the straggler: {async_wall:.2f}s"
+        # the synchronous barrier waits out the stall every round
+        sy = lsgd()
+        sy.arm_elastic(quorum=1)
+        sy.chaos = ChaosMonkey(slow_worker=1, slow_s=0.3, log_fn=None)
+        sy.train_round(round_batches(seed=0))
+        t0 = time.perf_counter()
+        for r in range(1, 3):
+            sy.train_round(round_batches(seed=r))
+        sync_wall = time.perf_counter() - t0
+        assert sync_wall >= 2 * 0.3
+
+    def test_chronically_parked_worker_evicted_as_staleness(self):
+        ms, buf = sink()
+        s = lsgd(metrics=ms, staleness=1)
+        s.arm_staleness(1, evict_parked_after=2)
+        s.chaos = ChaosMonkey(slow_worker=2, slow_s=60.0, log_fn=None)
+        for r in range(10):
+            s.train_round(round_batches(seed=r))
+        assert s.elastic.evictions, "chronic park never escalated"
+        assert s.elastic.evictions[0]["worker"] == 2
+        assert s.elastic.evictions[0]["reason"] == "staleness"
+        s.close()
+        assert any(e["event"] == "eviction" and e["reason"] == "staleness"
+                   for e in events_of(buf))
+
+    def test_chronic_staleness_eviction_respects_quorum(self):
+        s = lsgd(workers=2, staleness=1)
+        s.arm_staleness(1, evict_parked_after=2)
+        s.elastic.quorum = 2
+        s.chaos = ChaosMonkey(slow_worker=1, slow_s=60.0, log_fn=None)
+        with pytest.raises(QuorumLost):
+            for r in range(10):
+                s.train_round(round_batches(workers=2, seed=r))
+
+    def test_dp_step_s0_bitwise_equals_masked(self):
+        """The DataParallelSolver threading: staleness at step
+        granularity, s=0 bit-for-bit the masked step."""
+        sp = dict(base_lr=0.05, lr_policy="fixed", random_seed=0,
+                  display=0)
+        rs = np.random.RandomState(3)
+        steps = [{"data": rs.randn(32, 16).astype(np.float32),
+                  "label": rs.randint(0, 4, 32).astype(np.int32)}
+                 for _ in range(3)]
+        plain = DataParallelSolver(Message("SolverParameter", **sp),
+                                   net_param=mlp_net(batch=32),
+                                   mesh=make_mesh({"data": 4}),
+                                   log_fn=None)
+        plain.arm_elastic(quorum=1)
+        for b in steps:
+            plain.train_step(dict(b))
+        a0 = DataParallelSolver(Message("SolverParameter", **sp),
+                                net_param=mlp_net(batch=32),
+                                mesh=make_mesh({"data": 4}),
+                                log_fn=None, staleness=0)
+        for b in steps:
+            a0.train_step(dict(b))
+        tree_bytes_equal(plain.params, a0.params)
+
+
+# ------------------------------------------------- host policy (unit) ----
+
+class TestStalenessPolicy:
+    def test_virtual_clocks_lag_and_cycle(self):
+        p = ElasticPolicy(4, staleness=1, log_fn=None)
+        # r0: the straggler (10 s/round vs 1 s) falls 1 behind; r1: 2
+        # behind -> PARKED; r2: unparked after the cooldown, resynced to
+        # the front (the replicated consensus is the re-broadcast)
+        p.advance_versions(0, 1.0, slow=(1, 10.0))
+        p.observe_staleness(0)
+        assert p.lag()[1] == 1 and not p.parked[1]
+        p.advance_versions(1, 1.0, slow=(1, 10.0))
+        p.observe_staleness(1)
+        assert p.parked[1]
+        assert len(p.parks) == 1 and p.parks[0]["worker"] == 1
+        p.advance_versions(2, 1.0, slow=(1, 10.0))
+        p.observe_staleness(2)
+        assert not p.parked[1] and p.lag()[1] == 0
+        assert p.version[1] == p.version[0]      # resynced to the front
+        assert p.unparks and p.unparks[0]["parked_rounds"] == 1
+        assert p.park_rounds[1] == 1
+
+    def test_consensus_weights_match_device_discount(self):
+        p = ElasticPolicy(4, staleness=2, s_decay=0.5, log_fn=None)
+        p.version[:] = [5, 4, 3, 1]
+        want = np.asarray(staleness_discount(
+            np.asarray([0, 1, 2, 4], np.float32), 2, 0.5))
+        np.testing.assert_allclose(p.consensus_weights(), want)
+
+    def test_sync_policy_has_zero_lag_and_unit_weights(self):
+        p = ElasticPolicy(3, log_fn=None)
+        assert p.lag().tolist() == [0, 0, 0]
+        assert p.consensus_weights().tolist() == [1.0, 1.0, 1.0]
+
+    def test_readmitted_worker_rejoins_at_front(self):
+        p = ElasticPolicy(3, staleness=1, evict_after=1, readmit_after=2,
+                          log_fn=None)
+        for r in range(4):
+            p.advance_versions(r, 1.0)
+        p.evict(2, 4, "test")
+        for r in range(5, 7):
+            p.advance_versions(r, 1.0)
+            p.observe_round(r)
+        assert p.alive[2] and p.version[2] == p.version[0]
+        assert p.lag()[2] == 0
+
+    def test_s_decay_validation(self):
+        with pytest.raises(ValueError, match="s_decay"):
+            ElasticPolicy(2, staleness=1, s_decay=0.0)
+
+    def test_summary_carries_staleness_fields(self):
+        p = ElasticPolicy(2, staleness=3, log_fn=None)
+        s = p.summary()
+        assert s["staleness"] == 3 and s["parks"] == 0
+        assert s["max_lag"] == 0
+
+
+# -------------------------------------------------- chaos spec (unit) ----
+
+class TestChaosSlowWorkerAndParse:
+    def test_parse_slow_worker(self):
+        m = ChaosMonkey.parse("slow_worker=1,slow_s=2.5,slow_round=3",
+                              log_fn=None)
+        assert m.slow_worker == 1 and m.slow_s == 2.5
+        assert m.slow_round == 3
+
+    def test_spec_gates_on_round_and_is_persistent(self):
+        m = ChaosMonkey(slow_worker=1, slow_s=2.0, slow_round=3,
+                        log_fn=None)
+        assert m.slow_worker_spec(2) is None
+        assert m.slow_worker_spec(3) == (1, 2.0)
+        assert m.slow_worker_spec(9) == (1, 2.0)   # persistent
+
+    def test_sync_rendering_sleeps_and_attributes(self):
+        m = ChaosMonkey(slow_worker=2, slow_s=0.05, log_fn=None)
+        t0 = time.perf_counter()
+        assert m.maybe_slow_worker(0) == 0.05
+        assert time.perf_counter() - t0 >= 0.05
+        assert m.pop_slow_worker() == (2, 0.05)
+        assert m.pop_slow_worker() is None
+
+    def test_malformed_value_names_token_and_lists_injectors(self):
+        with pytest.raises(ValueError) as ei:
+            ChaosMonkey.parse("nan_step=abc", log_fn=None)
+        msg = str(ei.value)
+        assert "nan_step=abc" in msg and "valid injectors" in msg
+        assert "slow_worker" in msg and "kill_host" in msg
+
+    def test_unknown_key_names_token_and_lists_injectors(self):
+        with pytest.raises(ValueError) as ei:
+            ChaosMonkey.parse("nan_stpe=3", log_fn=None)
+        msg = str(ei.value)
+        assert "nan_stpe" in msg and "valid injectors" in msg
+
+    def test_missing_equals_names_token(self):
+        with pytest.raises(ValueError, match="valid injectors"):
+            ChaosMonkey.parse("stall", log_fn=None)
+
+    def test_well_formed_spec_still_parses(self):
+        m = ChaosMonkey.parse("kill_worker=2,kill_round=5,dead_p=0.1",
+                              log_fn=None)
+        assert m.kill_worker == 2 and m.dead_p == 0.1
+
+
+# --------------------------------------- heartbeat: ghosts + async relay ----
+
+class TestGhostReaping:
+    def test_stale_lease_and_orphans_reaped_with_event(self, tmp_path):
+        ms, buf = sink()
+        _atomic_write_json(os.path.join(str(tmp_path), "hb-1.json"),
+                           {"host": 1, "seq": 9, "round": 40,
+                            "stamp": time.time() - 500})
+        orphan = os.path.join(str(tmp_path), "delta-1-40.npz")
+        open(orphan, "wb").write(b"ghost")
+        os.utime(orphan, (time.time() - 500,) * 2)
+        c = _coord(tmp_path, 0, 2, metrics=ms).start()
+        try:
+            assert not os.path.exists(
+                os.path.join(str(tmp_path), "hb-1.json"))
+            assert not os.path.exists(orphan)
+            # the ghost does NOT satisfy the gate: host 1 gets startup
+            # grace, then its absence is a lease expiry, not an arrival
+            assert 1 not in c.peers()
+            evs = events_of(buf)
+            reaped = [e for e in evs if e["event"] == "ghost_reaped"]
+            assert reaped and reaped[0]["hosts"] == ["1"]
+            assert reaped[0]["orphaned_files"] == 1
+        finally:
+            c.stop()
+
+    def test_fresh_peer_lease_is_not_reaped(self, tmp_path):
+        a = _coord(tmp_path, 0, 2).start()
+        try:
+            b = _coord(tmp_path, 1, 2).start()
+            b.stop()
+            # b's lease is fresh: a later-starting coordinator must not
+            # destroy it
+            c = HeartbeatCoordinator(str(tmp_path), host=0, n_hosts=2,
+                                     interval_s=0.1, lease_s=5.0,
+                                     log_fn=None)
+            c._reap_ghosts()
+            assert os.path.exists(
+                os.path.join(str(tmp_path), "hb-1.json"))
+        finally:
+            a.stop()
+
+
+class TestAsyncFileConsensus:
+    def test_in_step_hosts_merge_at_full_weight(self, tmp_path):
+        a = _coord(tmp_path, 0, 2).start()
+        b = _coord(tmp_path, 1, 2).start()
+        try:
+            fa = AsyncFileConsensus(a, s=1)
+            fb = AsyncFileConsensus(b, s=1)
+            fb._push(0, [np.full(4, 2.0, np.float32)], True, 1.0)
+            out, aux = fa.exchange(0, [np.zeros(4, np.float32)], True,
+                                   0.5, [0, 1])
+            np.testing.assert_allclose(out[0], 1.0)
+            assert list(aux["valid"]) == [1.0, 1.0]
+            assert float(aux["n_live"]) == 2
+            assert aux["transport"] == "async-relay"
+            # b adopts the identical published consensus
+            out_b, _ = fb.exchange(0, [np.full(4, 2.0, np.float32)],
+                                   True, 1.0, [0, 1])
+            np.testing.assert_array_equal(out[0], out_b[0])
+        finally:
+            a.stop()
+            b.stop()
+
+    def test_never_blocks_on_missing_peer(self, tmp_path):
+        a = _coord(tmp_path, 0, 2).start()
+        try:
+            fa = AsyncFileConsensus(a, s=2)
+            t0 = time.perf_counter()
+            out, aux = fa.exchange(0, [np.full(3, 7.0, np.float32)],
+                                   True, 0.1, [0, 1])
+            assert time.perf_counter() - t0 < 0.5, "exchange blocked"
+            np.testing.assert_allclose(out[0], 7.0)
+        finally:
+            a.stop()
+
+    def test_lagging_host_discounted_then_parks(self, tmp_path):
+        a = _coord(tmp_path, 0, 2).start()
+        b = _coord(tmp_path, 1, 2).start()
+        try:
+            fa = AsyncFileConsensus(a, s=1, decay=0.5)
+            fb = AsyncFileConsensus(b, s=1, decay=0.5)
+            fb.exchange(0, [np.full(2, 2.0, np.float32)], True, 1.0,
+                        [0, 1])
+            for r in range(4):                 # a races ahead
+                out, aux = fa.exchange(
+                    r, [np.full(2, float(r), np.float32)], True, 0.1,
+                    [0, 1])
+            assert aux["lag"][1] >= 2
+            # b is over the bound now: its next exchange parks + resyncs
+            out_b, aux_b = fb.exchange(
+                1, [np.full(2, 2.0, np.float32)], True, 1.0, [0, 1])
+            assert aux_b["parked_self"] and fb.parks == 1
+            assert aux_b["version"] >= aux["version"] - 1
+        finally:
+            a.stop()
+            b.stop()
+
+    def test_lease_expired_hosts_deltas_reaped(self, tmp_path):
+        a = _coord(tmp_path, 0, 2, lease=0.4, interval=0.1).start()
+        b = _coord(tmp_path, 1, 2, lease=0.4, interval=0.1).start()
+        try:
+            fa = AsyncFileConsensus(a, s=1)
+            fb = AsyncFileConsensus(b, s=1)
+            fb.exchange(0, [np.ones(2, np.float32)], True, 1.0, [0, 1])
+            b.stop()                           # b dies; lease expires
+            time.sleep(0.6)
+            fa.exchange(0, [np.ones(2, np.float32)], True, 0.1, [0])
+            import glob as g
+            left = g.glob(os.path.join(str(tmp_path), "delta-1-*.json"))
+            assert not left, "dead host's deltas were not reaped"
+        finally:
+            a.stop()
+            b.stop()
+
+
+# ------------------------------------------- report / monitor surfaces ----
+
+class TestStalenessSurfaces:
+    def test_report_staleness_section(self):
+        from sparknet_tpu.obs import report as obs_report
+        evs = [
+            {"event": "staleness", "round": 5, "s": 2,
+             "version": [5, 3, 5, 5], "lag": [0, 2, 0, 0],
+             "parked": [], "park_rounds": [0, 1, 0, 0],
+             "weight": [1.0, 0.25, 1.0, 1.0]},
+            {"event": "parked", "worker": 1, "round": 3, "lag": 3},
+            {"event": "unparked", "worker": 1, "round": 4,
+             "parked_rounds": 1},
+            {"event": "divergence", "mean": 0.1, "lag": [0, 2, 0, 0],
+             "drift_cause": "staleness", "drift_stale_frac": 0.9},
+        ]
+        rep = obs_report.aggregate(evs)
+        sa = rep["staleness"]
+        assert sa["parks"] == 1 and sa["unparks"] == 1
+        assert sa["parks_by_worker"] == {"1": 1}
+        assert sa["s"] == 2 and sa["max_lag"] == 2
+        assert sa["drift_cause"] == {"staleness": 1}
+        text = obs_report.render(rep)
+        assert "async staleness" in text
+        assert "parks by worker: w1: 1" in text
+        assert "drift attribution: staleness: 1" in text
+
+    def test_report_zero_selection_is_an_error(self, tmp_path):
+        from sparknet_tpu.obs import report as obs_report
+        p = os.path.join(str(tmp_path), "m.jsonl")
+        with open(p, "w") as f:
+            f.write(json.dumps({"event": "train", "t": 1.0,
+                                "iter": 0, "loss": 2.0}) + "\n")
+        with pytest.raises(obs_report.MetricsFileError,
+                           match="selected 0 of 1"):
+            obs_report.report_file(p, out=lambda s: None, since=99.0)
+        with pytest.raises(obs_report.MetricsFileError,
+                           match="selected 0 of 1"):
+            obs_report.report_file(p, out=lambda s: None,
+                                   event_types=["health"])
+        # a selection that matches still renders
+        rep = obs_report.report_file(p, out=lambda s: None, since=0.5,
+                                     event_types=["train"])
+        assert rep["train"]["points"] == 1
+
+    def test_report_cli_since_exit_code(self, tmp_path, capsys):
+        from sparknet_tpu.cli import main
+        p = os.path.join(str(tmp_path), "m.jsonl")
+        with open(p, "w") as f:
+            f.write(json.dumps({"event": "train", "t": 1.0,
+                                "iter": 0, "loss": 2.0}) + "\n")
+        assert main(["report", p, "--since", "99"]) == 2
+        err = capsys.readouterr().err
+        assert err.count("\n") == 1 and "selected 0" in err
+
+    def test_monitor_folds_staleness(self):
+        from sparknet_tpu.obs.monitor import MonitorState
+        st = MonitorState()
+        st.update({"event": "staleness", "s": 1, "lag": [0, 2, 0, 0],
+                   "parked": [1], "version": [4, 2, 4, 4]})
+        st.update({"event": "parked", "worker": 1, "round": 3, "lag": 2})
+        st.update({"event": "unparked", "worker": 1, "round": 4,
+                   "parked_rounds": 1})
+        text = st.render("x.jsonl")
+        assert "staleness: s=1" in text
+        assert "parks 1 (w1:1)" in text and "unparks 1" in text
+        assert "last park: worker 1 round 3 (lag 2)" in text
+
+    def test_health_staleness_detectors(self):
+        from sparknet_tpu.obs.health import HealthMonitor
+        ms, buf = sink()
+        h = HealthMonitor(ms, log_fn=None, cooldown=1)
+        h.observe_round(10, round_idx=5, lag=[0, 1, 0, 0], parked=[],
+                        staleness=1)
+        h.observe_round(12, round_idx=6, lag=[0, 2, 0, 0], parked=[1],
+                        staleness=1)
+        evs = events_of(buf)
+        kinds = [e["kind"] for e in evs if e["event"] == "health"]
+        assert "staleness_high" in kinds and "parked_worker" in kinds
+        hi = next(e for e in evs if e.get("kind") == "staleness_high")
+        assert hi["worker"] == 1 and hi["suggest_s"] == 2
+        assert h.s_suggestion == 2
+        assert h.summary()["s_suggestion"] == 2
+
+    def test_cli_staleness_flag_arms_policy(self):
+        import argparse
+        from sparknet_tpu.cli import _apply_elastic_flags
+        s = lsgd()
+        args = argparse.Namespace(quorum=0, evict_after=None,
+                                  readmit_after=None, staleness=2,
+                                  s_decay=0.25, unpark_after=2,
+                                  evict_stale_after=3)
+        _apply_elastic_flags(s, args)
+        assert s.staleness == 2 and s.s_decay == 0.25
+        assert s.elastic is not None and s.elastic.staleness == 2
+        assert s.elastic.unpark_after == 2
+        assert s.elastic.evict_parked_after == 3
+        s.close()

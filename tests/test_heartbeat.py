@@ -329,7 +329,7 @@ class TestHostChaos:
         assert ch.maybe_slow_host(1, 1) == 0.0    # fires once
 
     def test_unknown_chaos_keys_still_rejected(self):
-        with pytest.raises(ValueError, match="unknown chaos keys"):
+        with pytest.raises(ValueError, match="unknown injector"):
             ChaosMonkey.parse("kill_hosts=1")
 
 

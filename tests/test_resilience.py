@@ -525,7 +525,7 @@ class TestChaos:
         assert m.sigterm_round == 3
 
     def test_parse_rejects_unknown_keys(self):
-        with pytest.raises(ValueError, match="unknown chaos keys"):
+        with pytest.raises(ValueError, match="unknown injector"):
             ChaosMonkey.parse("nan_stpe=30")
 
     def test_poison_fires_once_unless_repeat(self):
