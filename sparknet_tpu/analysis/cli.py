@@ -18,6 +18,18 @@ from .engine import LintEngine, ALL_CODES, all_rules, SEVERITY_ERROR
 from .baseline import Baseline
 
 DEFAULT_BASELINE = ".sparknet-lint-baseline.json"
+DEFAULT_CACHE = ".sparknet-lint-cache.json"
+
+# --select profiles: the relaxed per-tree rule sets scripts/lint.sh
+# applies outside the package source. Tests monkeypatch state and poke
+# internals on purpose, so only the parse + file-protocol + exit-code
+# rules hold there; tools/experiments additionally get the host-sync
+# JAX hazard rules.
+SELECT_PROFILES = {
+    "@tests": {"SPK001", "SPK301", "SPK302", "SPK304"},
+    "@tools": {"SPK001", "SPK101", "SPK103", "SPK104", "SPK105",
+               "SPK301", "SPK302", "SPK303", "SPK304"},
+}
 
 
 def default_target():
@@ -67,18 +79,38 @@ def run_lint(args, out=print, err=None):
         paths, root = default_target()
         if args.root:
             root = os.path.abspath(args.root)
+    if getattr(args, "write_event_schema", False):
+        from .metrics_rules import write_event_schema
+        path = write_event_schema(root)
+        out(f"event schema written: {path}")
+        return 0
     select = None
     if args.select:
-        select = {c.strip().upper() for c in args.select.split(",")
-                  if c.strip()}
+        select = set()
+        for c in args.select.split(","):
+            c = c.strip()
+            if not c:
+                continue
+            if c.lower() in SELECT_PROFILES:
+                select |= SELECT_PROFILES[c.lower()]
+            else:
+                select.add(c.upper())
         all_rules()
         unknown = select - set(ALL_CODES) - {"SPK001"}
         if unknown:
-            err(f"sparknet lint: error: unknown rule code(s): "
-                f"{', '.join(sorted(unknown))}")
+            err(f"sparknet lint: error: unknown rule code(s) or "
+                f"profile(s): {', '.join(sorted(unknown))}")
             return 2
 
-    findings = LintEngine(select=select).run(paths, root=root)
+    cache_path = None
+    if getattr(args, "cache", False):
+        cache_path = os.path.join(root, DEFAULT_CACHE)
+
+    engine = LintEngine(select=select,
+                        exclude=getattr(args, "exclude", None),
+                        jobs=getattr(args, "jobs", 1) or 1,
+                        cache_path=cache_path)
+    findings = engine.run(paths, root=root)
 
     baseline_path = args.baseline or _find_baseline(paths, root)
     try:

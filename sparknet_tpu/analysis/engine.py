@@ -190,7 +190,8 @@ def _load_rules():
     ALL_CODES.setdefault(
         "SPK001", ("parse-error", SEVERITY_ERROR,
                    "File does not parse; nothing else can be checked."))
-    from . import jax_rules, thread_rules   # noqa: F401  (registration)
+    from . import (jax_rules, thread_rules, protocol_rules,  # noqa: F401
+                   metrics_rules)                            # (registration)
 
 
 # -- helpers rules share ----------------------------------------------------
@@ -233,6 +234,10 @@ class LintContext:
         like ``masked_consensus(tree, valid, "data")`` be checked
         against the caller's declared mesh axes even though the psum
         lives in another module (resilience/elastic.py).
+    project: the :class:`~.project.ProjectIndex` — module graph,
+        class/method + call-edge resolution, expression-fragment
+        constant propagation, event/kind registries, exit table.
+        The SPK2xx/3xx/4xx cross-module families query this.
     """
 
     def __init__(self, modules):
@@ -244,9 +249,11 @@ class LintContext:
             self._collect_constants(m)
         _load_rules()
         from .jax_rules import collect_axis_helpers
+        from .project import ProjectIndex
         for m in modules:
             for name, idxs in collect_axis_helpers(m).items():
                 self.axis_helpers.setdefault(name, set()).update(idxs)
+        self.project = ProjectIndex(modules)
 
     def _collect_constants(self, module):
         for node in module.tree.body:
@@ -269,27 +276,125 @@ class LintContext:
         return self.str_constants.get(name)
 
 
+def _lint_module(module, ctx, select):
+    """All unsuppressed findings for one module — the per-file unit of
+    work the cache stores and the worker pool executes."""
+    out = []
+    for fn in all_rules():
+        if select and fn.code not in select:
+            continue
+        try:
+            found = list(fn(module, ctx))
+        except RecursionError:              # pathological nesting: skip
+            continue                        # the rule, not the run
+        for f in found:
+            if not module.suppressed(f.code, f.line):
+                out.append(f)
+    return out
+
+
+# fork-pool plumbing: children inherit this via fork, so the parsed
+# modules and the ProjectIndex are shared copy-on-write instead of
+# pickled per task
+_POOL_STATE = {}
+
+
+def _pool_lint(i):
+    ctx, select = _POOL_STATE["ctx"], _POOL_STATE["select"]
+    return i, _lint_module(_POOL_STATE["modules"][i], ctx, select)
+
+
+def _analysis_version():
+    """Hash of this package's sources — cached results die with any
+    rule change."""
+    h = hashlib.sha256()
+    pkg = os.path.dirname(os.path.abspath(__file__))
+    for fn in sorted(os.listdir(pkg)):
+        if fn.endswith(".py"):
+            with open(os.path.join(pkg, fn), "rb") as f:
+                h.update(f.read())
+    return h.hexdigest()[:16]
+
+
 class LintEngine:
-    """Parse targets, run every registered rule, apply suppressions,
+    """Parse targets, run every registered rule (optionally across a
+    worker pool, with a content-hash result cache), apply suppressions,
     stamp occurrence indices for stable fingerprints."""
 
-    def __init__(self, select=None):
+    def __init__(self, select=None, exclude=None, jobs=1,
+                 cache_path=None):
         self.select = set(select) if select else None
+        self.exclude = list(exclude) if exclude else []
+        self.jobs = max(1, int(jobs or 1))
+        self.cache_path = cache_path
+
+    def _excluded(self, path):
+        norm = path.replace(os.sep, "/")
+        import fnmatch
+        for pat in self.exclude:
+            if pat in norm or fnmatch.fnmatch(norm, pat) or \
+                    any(fnmatch.fnmatch(part, pat)
+                        for part in norm.split("/")):
+                return True
+        return False
 
     def collect_files(self, paths):
         files = []
         for p in paths:
             if os.path.isfile(p):
-                files.append(p)
+                if not self._excluded(p):
+                    files.append(p)
                 continue
             for dirpath, dirnames, filenames in os.walk(p):
                 dirnames[:] = sorted(d for d in dirnames
                                      if d not in _SKIP_DIRS
-                                     and not d.startswith("."))
+                                     and not d.startswith(".")
+                                     and not self._excluded(
+                                         os.path.join(dirpath, d)))
                 for fn in sorted(filenames):
-                    if fn.endswith(".py"):
-                        files.append(os.path.join(dirpath, fn))
+                    full = os.path.join(dirpath, fn)
+                    if fn.endswith(".py") and not self._excluded(full):
+                        files.append(full)
         return files
+
+    # -- result cache ------------------------------------------------------
+
+    def _load_cache(self):
+        import json
+        try:
+            with open(self.cache_path) as f:
+                data = json.load(f)
+            if isinstance(data, dict) and \
+                    isinstance(data.get("entries"), dict):
+                return data["entries"]
+        except (OSError, ValueError):
+            pass
+        return {}
+
+    def _save_cache(self, entries):
+        import json
+        tmp = self.cache_path + ".tmp"
+        try:
+            with open(tmp, "w") as f:
+                json.dump({"entries": entries}, f)
+            os.replace(tmp, self.cache_path)
+        except OSError:
+            pass
+
+    @staticmethod
+    def _file_key(module, ctx_fp):
+        h = hashlib.sha256()
+        h.update(module.source.encode("utf-8", "replace"))
+        h.update(ctx_fp.encode())
+        return h.hexdigest()[:24]
+
+    @staticmethod
+    def _finding_from_dict(d):
+        return Finding(d["code"], d["message"], d["path"], d["line"],
+                       d.get("col", 0), severity=d.get("severity",
+                                                       SEVERITY_ERROR),
+                       symbol=d.get("symbol", ""),
+                       rule_name=d.get("rule", ""))
 
     def run(self, paths, root=None):
         """Lint ``paths`` (files or directories). Returns the sorted,
@@ -309,17 +414,45 @@ class LintEngine:
                     line, severity=SEVERITY_ERROR,
                     symbol="<module>", rule_name="parse-error"))
         ctx = LintContext(modules)
-        for module in modules:
-            for fn in all_rules():
-                if self.select and fn.code not in self.select:
+
+        # cache key: file content + every cross-module input a rule can
+        # see (project summaries, rule sources, selection) — editing one
+        # file invalidates others only when a shared summary changed
+        cache, ctx_fp = None, ""
+        if self.cache_path:
+            ctx_fp = "|".join([_analysis_version(),
+                               ctx.project.fingerprint(),
+                               ",".join(sorted(self.select or ()))])
+            cache = self._load_cache()
+        pending = []
+        for i, module in enumerate(modules):
+            if cache is not None:
+                key = self._file_key(module, ctx_fp)
+                hit = cache.get(module.relpath)
+                if hit and hit.get("key") == key:
+                    findings.extend(self._finding_from_dict(d)
+                                    for d in hit.get("findings", ()))
                     continue
-                try:
-                    found = list(fn(module, ctx))
-                except RecursionError:      # pathological nesting: skip
-                    continue                # the rule, not the run
-                for f in found:
-                    if not module.suppressed(f.code, f.line):
-                        findings.append(f)
+            pending.append(i)
+
+        results = None
+        if self.jobs > 1 and len(pending) > 1:
+            results = self._run_pool(modules, ctx, pending)
+        if results is None:
+            results = {i: _lint_module(modules[i], ctx, self.select)
+                       for i in pending}
+        for i in pending:
+            found = results.get(i, [])
+            findings.extend(found)
+            if cache is not None:
+                cache[modules[i].relpath] = {
+                    "key": self._file_key(modules[i], ctx_fp),
+                    "findings": [f.to_dict() for f in found]}
+        if cache is not None:
+            live = {m.relpath for m in modules}
+            self._save_cache({k: v for k, v in cache.items()
+                              if k in live})
+
         findings.sort(key=Finding.sort_key)
         seen = {}
         for f in findings:
@@ -331,6 +464,24 @@ class LintEngine:
             f._occurrence = seen.get(key, 0)
             seen[key] = f._occurrence + 1
         return findings
+
+    def _run_pool(self, modules, ctx, pending):
+        """Fan pending modules over a fork pool; the children inherit
+        the parsed modules and ProjectIndex copy-on-write. Returns
+        {index: findings} or None when fork isn't available."""
+        import multiprocessing
+        try:
+            mp = multiprocessing.get_context("fork")
+        except ValueError:
+            return None
+        _POOL_STATE.update(modules=modules, ctx=ctx, select=self.select)
+        try:
+            with mp.Pool(min(self.jobs, len(pending))) as pool:
+                return dict(pool.map(_pool_lint, pending))
+        except Exception:
+            return None                     # fall back to serial
+        finally:
+            _POOL_STATE.clear()
 
 
 def lint_paths(paths, root=None, select=None):

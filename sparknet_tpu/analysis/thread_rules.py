@@ -366,3 +366,296 @@ def unannotated_shared_write(module, ctx):
                 "guarded-by annotation — annotate it (spk: guarded-by="
                 "<lock>) or mark it `spk: unguarded` with a reason",
                 node=node, symbol=f"{ci.name}")
+
+
+# -- SPK205-207: the cross-module deadlock family ---------------------------
+#
+# These three run on the ProjectIndex (ctx.project): lock-acquisition
+# edges follow resolved call edges across methods and classes, so a
+# cycle split between heartbeat and the consensus helper it calls is
+# still one cycle.
+
+
+def _lock_graph(ctx):
+    """{(class, lock): {(class, lock): (relpath, line, via)}} — edge
+    A->B when some method acquires B while holding A, directly or
+    through a resolved callee. Built once per lint run."""
+    proj = ctx.project
+    cached = getattr(proj, "_lock_graph", None)
+    if cached is not None:
+        return cached
+    edges = {}
+
+    def add(src, dst, relpath, line, via):
+        edges.setdefault(src, {}).setdefault(dst, (relpath, line, via))
+
+    for fi in proj.functions.values():
+        if fi.cls is None:
+            continue
+        cls = fi.cls
+        module = proj.modules.get(fi.relpath)
+        held0 = set()
+        hm = _HOLDS_RE.search(module.line_text(fi.node.lineno))
+        if hm:
+            held0.add(hm.group(1))
+
+        def visit(node, held, _cls=cls, _mod=module, _fi=fi):
+            held_locks = {h for h in held if h in _cls.locks}
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    e = item.context_expr
+                    if isinstance(e, ast.Attribute) and \
+                            isinstance(e.value, ast.Name) and \
+                            e.value.id == "self" and \
+                            e.attr in _cls.locks:
+                        for h in held_locks:
+                            add((_cls.name, h), (_cls.name, e.attr),
+                                _fi.relpath, node.lineno,
+                                _fi.qualname)
+            elif isinstance(node, ast.Call) and held_locks:
+                target = proj.resolve_call(node, _mod, _fi.node)
+                if target is None:
+                    return
+                for dst in proj.transitive_acquires(target.key):
+                    for h in held_locks:
+                        add((_cls.name, h), dst, _fi.relpath,
+                            node.lineno, target.qualname)
+
+        _held_locks_walk(fi.node, visit, initial_held=held0)
+    proj._lock_graph = edges
+    return edges
+
+
+def _sccs(edges):
+    """Tarjan SCCs of the lock graph (iterative)."""
+    index, low, on_stack = {}, {}, set()
+    stack, out, counter = [], [], [0]
+    nodes = set(edges)
+    for tgts in edges.values():
+        nodes |= set(tgts)
+
+    def strongconnect(v0):
+        work = [(v0, iter(edges.get(v0, ())))]
+        index[v0] = low[v0] = counter[0]
+        counter[0] += 1
+        stack.append(v0)
+        on_stack.add(v0)
+        while work:
+            v, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in index:
+                    index[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    on_stack.add(w)
+                    work.append((w, iter(edges.get(w, ()))))
+                    advanced = True
+                    break
+                if w in on_stack:
+                    low[v] = min(low[v], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if low[v] == index[v]:
+                comp = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    comp.append(w)
+                    if w == v:
+                        break
+                out.append(comp)
+            if work:
+                pv = work[-1][0]
+                low[pv] = min(low[pv], low[v])
+
+    for v in sorted(nodes):
+        if v not in index:
+            strongconnect(v)
+    return out
+
+
+def _cycle_path(edges, start, comp):
+    """One concrete simple cycle through ``start`` inside SCC ``comp``."""
+    comp = set(comp)
+    path, seen = [start], {start}
+    v = start
+    while True:
+        nxt = None
+        for w in sorted(edges.get(v, ())):
+            if w == start and len(path) > 1:
+                return path
+            if w in comp and w not in seen:
+                nxt = w
+                break
+        if nxt is None:
+            return path
+        path.append(nxt)
+        seen.add(nxt)
+        v = nxt
+
+
+@rule("SPK205", "lock-order-cycle", SEVERITY_ERROR)
+def lock_order_cycle(module, ctx):
+    """Two locks are acquired in opposite orders on different paths
+    (following resolved call edges across methods and classes), or a
+    non-reentrant lock is re-acquired while already held — a deadlock
+    waiting for the right interleaving. Fix by ordering every path the
+    same way, or by narrowing one side to drop its lock first."""
+    edges = _lock_graph(ctx)
+    # self-edges: re-acquiring a non-reentrant lock you already hold
+    for src in sorted(edges):
+        info = edges[src].get(src)
+        if info is None:
+            continue
+        relpath, line, via = info
+        if relpath != module.relpath:
+            continue
+        cname, lock = src
+        ctor = None
+        for cf in ctx.project.classes_by_name.get(cname, []):
+            ctor = cf.sync_ctors.get(lock, ctor)
+        if ctor == "RLock":
+            continue
+        yield make_finding(
+            lock_order_cycle, module,
+            f"`{cname}.{lock}` ({ctor or 'Lock'}) is re-acquired via "
+            f"`{via}` while already held — non-reentrant locks "
+            "self-deadlock here",
+            line=line, symbol=f"{cname}.{lock}")
+    # multi-node SCCs: a genuine ordering cycle
+    for comp in _sccs(edges):
+        if len(comp) < 2:
+            continue
+        anchor = None          # smallest (relpath, line) edge in SCC
+        cset = set(comp)
+        for a in comp:
+            for b, (relpath, line, _via) in edges.get(a, {}).items():
+                if b in cset:
+                    k = (relpath, line, a, b)
+                    if anchor is None or k < anchor:
+                        anchor = k
+        if anchor is None or anchor[0] != module.relpath:
+            continue
+        path = _cycle_path(edges, anchor[2], comp)
+        names = [f"`{c}.{l}`" for c, l in path] + \
+            [f"`{path[0][0]}.{path[0][1]}`"]
+        legs = []
+        for i in range(len(path)):
+            a = path[i]
+            b = path[(i + 1) % len(path)]
+            relpath, line, via = edges[a][b]
+            legs.append(f"{relpath}:{line} (via `{via}`)")
+        yield make_finding(
+            lock_order_cycle, module,
+            "lock-order cycle " + " -> ".join(names) +
+            "; acquired at " + ", ".join(legs),
+            line=anchor[1], symbol=f"{anchor[2][0]}.{anchor[2][1]}")
+
+
+@rule("SPK206", "blocking-call-under-lock", SEVERITY_ERROR)
+def blocking_call_under_lock(module, ctx):
+    """A lock is held across a call that can block indefinitely —
+    sleep, file I/O, a thread join, a queue get, an event wait — found
+    transitively through resolved call edges. Every other thread
+    touching that lock now stalls behind the slow operation (the
+    heartbeat writer stalling the solver loop on a slow NFS fsync is
+    the canonical case). Snapshot state under the lock, do the blocking
+    work outside it."""
+    proj = ctx.project
+    for ci in _classes(module):
+        if not ci.locks:
+            continue
+        for mname, mnode in ci.methods.items():
+            fkey = (module.relpath, f"{ci.name}.{mname}")
+            hits = []
+
+            def visit(node, held, _ci=ci, _mnode=mnode, _hits=hits):
+                held_locks = {h for h in held if h in _ci.locks}
+                if not held_locks or not isinstance(node, ast.Call):
+                    return
+                # Condition.wait RELEASES the lock it is guarded by —
+                # `with self._cv: self._cv.wait()` is the idiom, not a
+                # stall
+                f = node.func
+                if isinstance(f, ast.Attribute) and \
+                        f.attr in ("wait", "wait_for", "notify",
+                                   "notify_all") and \
+                        isinstance(f.value, ast.Attribute) and \
+                        isinstance(f.value.value, ast.Name) and \
+                        f.value.value.id == "self" and \
+                        f.value.attr in held:
+                    return
+                lock = sorted(held_locks)[0]
+                desc = proj.classify_blocking(node, module, _mnode)
+                if desc is not None:
+                    _hits.append((node, lock, desc))
+                    return
+                target = proj.resolve_call(node, module, _mnode)
+                if target is not None:
+                    sub = proj.transitively_blocking(target.key)
+                    if sub is not None:
+                        _hits.append(
+                            (node, lock,
+                             f"`{target.qualname}` → {sub}"))
+
+            held0 = set()
+            hm = _HOLDS_RE.search(module.line_text(mnode.lineno))
+            if hm:
+                held0.add(hm.group(1))
+            _held_locks_walk(mnode, visit, initial_held=held0)
+            for node, lock, desc in hits:
+                yield make_finding(
+                    blocking_call_under_lock, module,
+                    f"`self.{lock}` is held across a blocking call: "
+                    f"{desc} — snapshot under the lock, block outside "
+                    "it",
+                    node=node, symbol=f"{ci.name}.{mname}")
+
+
+@rule("SPK207", "callback-under-lock", SEVERITY_ERROR)
+def callback_under_lock(module, ctx):
+    """A stored callback (``self.on_x = on_x``) is invoked while the
+    emitter's own lock is held. The callback is arbitrary user code: if
+    it calls back into this object (or logs through something that
+    does) it deadlocks on the very lock we hold; and the dwell time
+    under the lock is unbounded. Snapshot, release, then fire."""
+    for ci in _classes(module):
+        if not ci.locks:
+            continue
+        pcls = None
+        for cf in ctx.project.classes_by_name.get(ci.name, []):
+            if cf.relpath == module.relpath:
+                pcls = cf
+        callbacks = pcls.callback_fields if pcls is not None else set()
+        if not callbacks:
+            continue
+        for mname, mnode in ci.methods.items():
+            hits = []
+
+            def visit(node, held, _ci=ci, _cb=callbacks, _hits=hits):
+                held_locks = {h for h in held if h in _ci.locks}
+                if not held_locks:
+                    return
+                if isinstance(node, ast.Call) and \
+                        isinstance(node.func, ast.Attribute) and \
+                        isinstance(node.func.value, ast.Name) and \
+                        node.func.value.id == "self" and \
+                        node.func.attr in _cb:
+                    _hits.append((node, node.func.attr,
+                                  sorted(held_locks)[0]))
+
+            held0 = set()
+            hm = _HOLDS_RE.search(module.line_text(mnode.lineno))
+            if hm:
+                held0.add(hm.group(1))
+            _held_locks_walk(mnode, visit, initial_held=held0)
+            for node, cb, lock in hits:
+                yield make_finding(
+                    callback_under_lock, module,
+                    f"callback `self.{cb}` invoked while holding "
+                    f"`self.{lock}` — a callback that re-enters this "
+                    "object deadlocks; snapshot under the lock and "
+                    "fire after releasing it",
+                    node=node, symbol=f"{ci.name}.{mname}")

@@ -254,6 +254,7 @@ def cmd_train(args):
     from .resilience.chaos import active_chaos
     from .resilience.recovery import RecoveryAbort
     from .resilience.elastic import QuorumLost, EXIT_QUORUM_LOST
+    from .utils.exit_codes import EXIT_RECOVERY_ABORT
     blocks_done = 0
     rc = 0
     try:
@@ -268,7 +269,7 @@ def cmd_train(args):
                         # clean abort: the run is over, but the last
                         # known-good snapshot (if any) is intact on disk
                         print(f"ABORT: {e}")
-                        rc = 3
+                        rc = EXIT_RECOVERY_ABORT
                         break
                     except QuorumLost as e:
                         # too few live workers for a trustworthy
@@ -1180,8 +1181,12 @@ def main(argv=None):
     li = sub.add_parser(
         "lint",
         help="static analysis: JAX hazard rules (host syncs/recompiles/"
-             "PRNG reuse/axis mismatches in jitted code) + the "
-             "guarded-by lock-discipline race checker")
+             "PRNG reuse/axis mismatches in jitted code), the "
+             "guarded-by lock-discipline race checker, deadlock rules "
+             "(lock-order cycles, blocking/callbacks under locks), "
+             "distributed file-protocol rules (atomic rendezvous "
+             "writes, bounded gates, canonical exit codes), and the "
+             "metrics event-schema rules")
     li.add_argument("paths", nargs="*",
                     help="files/directories to lint (default: the "
                          "sparknet_tpu package source)")
@@ -1203,7 +1208,31 @@ def main(argv=None):
                          "added by --write-baseline")
     li.add_argument("--select", metavar="CODES",
                     help="comma-separated rule codes to run "
-                         "(e.g. SPK101,SPK201)")
+                         "(e.g. SPK101,SPK201), or a profile: @tests "
+                         "(parse/file-protocol/exit-code rules for the "
+                         "test tree), @tools (those plus the JAX "
+                         "host-sync hazards, for scripts/ and "
+                         "experiments/)")
+    li.add_argument("--exclude", action="append", default=[],
+                    metavar="PATTERN",
+                    help="skip files whose path matches (substring, "
+                         "glob, or path-component glob); repeatable — "
+                         "e.g. --exclude fixtures")
+    li.add_argument("--jobs", type=int, default=1, metavar="N",
+                    help="lint files across N forked workers (the "
+                         "parsed project index is shared "
+                         "copy-on-write)")
+    li.add_argument("--cache", action="store_true",
+                    help="reuse per-file results keyed on content + "
+                         "rule sources + cross-module summaries "
+                         "(.sparknet-lint-cache.json next to the "
+                         "root; safe to delete any time)")
+    li.add_argument("--write-event-schema", action="store_true",
+                    help="regenerate sparknet_tpu/obs/event_schema.py "
+                         "from the repo's metrics emit sites and exit "
+                         "(rules SPK401/402 and "
+                         "tests/test_event_schema.py check against "
+                         "it)")
     li.add_argument("--root", help="directory finding paths are "
                                    "reported relative to (default: "
                                    "CWD, or the package parent when "

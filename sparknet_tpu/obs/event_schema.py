@@ -1,0 +1,162 @@
+"""Metrics event registry — GENERATED, do not edit by hand.
+
+Every event name the repo emits via ``metrics.log(...)`` with
+the union of field names seen at its emit sites (``open`` =
+some site forwards **kwargs, so the field set is not closed).
+Consumers (obs/report.py, obs/monitor.py) may only filter on
+names in this registry — `sparknet lint` rule SPK401 and
+tests/test_event_schema.py both enforce it.
+
+Regenerate with:  python -m sparknet_tpu lint --write-event-schema
+"""
+
+EVENTS = {
+    'bench': {
+        "fields": [],
+        "open": True,
+    },
+    'bench_config': {
+        "fields": ['device', 'iters_per_window', 'peak_bf16_flops', 'platform', 'warmup', 'windows'],
+        "open": False,
+    },
+    'bench_headline': {
+        "fields": [],
+        "open": True,
+    },
+    'chaos': {
+        "fields": ['kind'],
+        "open": True,
+    },
+    'checkpoint': {
+        "fields": ['bytes', 'dropped', 'format', 'iter', 'kept', 'kind', 'model', 'refused', 'state'],
+        "open": False,
+    },
+    'comms': {
+        "fields": [],
+        "open": True,
+    },
+    'config': {
+        "fields": ['batch', 'd_model', 'dtype', 'layers', 'loss_floor_nats', 'pipeline_stages', 'seq_len'],
+        "open": False,
+    },
+    'device_cache': {
+        "fields": ['hit_rate', 'hits', 'misses', 'nbytes', 'reason', 'records', 'resident', 'source'],
+        "open": True,
+    },
+    'divergence': {
+        "fields": [],
+        "open": True,
+    },
+    'eviction': {
+        "fields": [],
+        "open": True,
+    },
+    'ghost_reaped': {
+        "fields": ['hosts', 'observer', 'orphaned_files'],
+        "open": False,
+    },
+    'hbm': {
+        "fields": ['iter'],
+        "open": True,
+    },
+    'health': {
+        "fields": ['cause', 'kind', 'severity'],
+        "open": True,
+    },
+    'health_summary': {
+        "fields": [],
+        "open": True,
+    },
+    'host_alive': {
+        "fields": ['alive', 'host', 'lease_age_s', 'observer'],
+        "open": False,
+    },
+    'host_evicted': {
+        "fields": ['host', 'live', 'reason', 'round'],
+        "open": False,
+    },
+    'host_round': {
+        "fields": ['arrived', 'dead', 'lease_age_s', 'observer', 'round', 'wait_s'],
+        "open": False,
+    },
+    'membership': {
+        "fields": ['agreed', 'from_world', 'hosts', 'kind', 'live', 'observer', 'quorum', 'round', 'sha', 'to_world', 'unit'],
+        "open": True,
+    },
+    'memstats': {
+        "fields": [],
+        "open": True,
+    },
+    'moe': {
+        "fields": ['eval_ce', 'expert_util', 'iter', 'overflow_fraction'],
+        "open": True,
+    },
+    'parked': {
+        "fields": ['lag', 'round', 'unit', 'worker'],
+        "open": True,
+    },
+    'prefetch': {
+        "fields": [],
+        "open": True,
+    },
+    'readmission': {
+        "fields": [],
+        "open": True,
+    },
+    'recompile': {
+        "fields": ['cache_size', 'first', 'iter', 'reason'],
+        "open": False,
+    },
+    'recovery': {
+        "fields": ['attempt', 'iter', 'kind', 'loss', 'lr_decay', 'reason', 'rollbacks', 'to_iter'],
+        "open": False,
+    },
+    'retry': {
+        "fields": ['attempt', 'error', 'exhausted', 'where'],
+        "open": False,
+    },
+    'round': {
+        "fields": ['images_per_s', 'iter', 'loss', 'lr', 'round'],
+        "open": False,
+    },
+    'span': {
+        "fields": [],
+        "open": True,
+    },
+    'staleness': {
+        "fields": ['lag', 'park_rounds', 'parked', 'round', 's', 'version', 'weight'],
+        "open": False,
+    },
+    'step': {
+        "fields": [],
+        "open": True,
+    },
+    'step_summary': {
+        "fields": ['iter', 'name'],
+        "open": True,
+    },
+    'summary': {
+        "fields": ['final_loss', 'loss_floor_nats', 'steps', 'tokens_per_sec'],
+        "open": False,
+    },
+    'test': {
+        "fields": ['iter', 'metric', 'round', 'value'],
+        "open": True,
+    },
+    'train': {
+        "fields": ['images_per_sec', 'iter', 'loss', 'lr', 'tokens_per_sec'],
+        "open": False,
+    },
+    'unparked': {
+        "fields": [],
+        "open": True,
+    },
+    'watchdog': {
+        "fields": ['elapsed_s', 'emergency_snapshot_ok', 'exit_code', 'kind', 'loss'],
+        "open": False,
+    },
+}
+
+KINDS = ['abort', 'coordinated_restart', 'killed', 'mesh_shrunk', 'nan', 'params', 'quorum_lost', 'recovery_armed', 'resume', 'rollback', 'stall', 'summary', 'world_reset']
+
+KINDS_OPEN = True

@@ -313,7 +313,10 @@ class PipelineLMSolver:
                 for s, h in enumerate(slots):
                     flat[f"h/{ln}@{i}@{s}"] = np.asarray(h)
         path = f"{prefix}_iter_{self.iter}.lm.npz"
-        np.savez(path, __iter__=self.iter, **flat)
+        # crash-safe: a relaunch must never see a torn .lm.npz (SPK301)
+        from ..resilience.checkpoint import atomic_write_bytes
+        atomic_write_bytes(
+            path, lambda f: np.savez(f, __iter__=self.iter, **flat))
         self.log(f"Snapshotting to {path}")
         return path
 

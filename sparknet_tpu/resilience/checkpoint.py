@@ -25,14 +25,17 @@ fresh when there is none.
 
 import glob
 import hashlib
+import itertools
 import json
 import os
 import re
+import threading
 import time
 
 
 MANIFEST_SUFFIX = ".latest.json"
 _TMP_TAG = ".tmp."
+_TMP_COUNTER = itertools.count()
 
 
 class WorldMismatch(RuntimeError):
@@ -129,14 +132,53 @@ def _fsync_dir(dirname):
         os.close(fd)
 
 
+def _tmp_name(path):
+    """A temp sibling of ``path`` unique to this (process, thread,
+    call): concurrent writers — the heartbeat writer thread racing a
+    round arrival, two hosts on one machine — can never collide on a
+    temp name, so interleaved atomic-rename sequences cannot eat each
+    other's os.replace. The _TMP_TAG marker keeps every half-written
+    file recognizable to the snapshot verifiers and the ghost reaper."""
+    return (f"{path}{_TMP_TAG}{os.getpid()}."
+            f"{threading.get_ident()}.{next(_TMP_COUNTER)}")
+
+
+def atomic_write_bytes(path, write_fn, fsync_dir=False):
+    """The repo's ONE tmp+fsync+os.replace writer (`sparknet lint`
+    SPK301 enforces that rendezvous/checkpoint paths go through this
+    shape). ``write_fn(f)`` receives the binary temp file; after it
+    returns the file is flushed, fsync'd, and atomically renamed to
+    ``path`` — a crash at any point leaves either the old file or a
+    recognizable ``.tmp.`` orphan, never a torn ``path``."""
+    tmp = _tmp_name(path)
+    try:
+        with open(tmp, "wb") as f:
+            write_fn(f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):         # write_fn raised: no partials
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+    if fsync_dir:
+        _fsync_dir(os.path.dirname(path))
+
+
+def atomic_write_json(path, obj, indent=None, sort_keys=False,
+                      fsync_dir=False):
+    """atomic_write_bytes for one JSON document (the lease / mask /
+    manifest / restart-barrier records)."""
+    data = json.dumps(obj, indent=indent, sort_keys=sort_keys)
+    atomic_write_bytes(path, lambda f: f.write(data.encode("utf-8")),
+                       fsync_dir=fsync_dir)
+
+
 def _atomic_write_json(path, obj):
-    tmp = f"{path}{_TMP_TAG}{os.getpid()}"
-    with open(tmp, "w") as f:
-        json.dump(obj, f, indent=1, sort_keys=True)
-        f.flush()
-        os.fsync(f.fileno())
-    os.replace(tmp, path)
-    _fsync_dir(os.path.dirname(path))
+    atomic_write_json(path, obj, indent=1, sort_keys=True,
+                      fsync_dir=True)
 
 
 def load_manifest(prefix):

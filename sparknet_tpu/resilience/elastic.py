@@ -75,8 +75,7 @@ fault domain.
 
 import numpy as np
 
-
-EXIT_QUORUM_LOST = 4
+from ..utils.exit_codes import EXIT_QUORUM_LOST  # noqa: F401  (re-export)
 
 
 class QuorumLost(RuntimeError):

@@ -56,14 +56,10 @@ import time
 
 import numpy as np
 
+from .checkpoint import atomic_write_bytes, atomic_write_json
 
-def _atomic_write_json(path, obj):
-    tmp = f"{path}.tmp.{os.getpid()}"
-    with open(tmp, "w") as f:
-        json.dump(obj, f)
-        f.flush()
-        os.fsync(f.fileno())
-    os.replace(tmp, path)
+# back-compat: this module's private writer predates the shared helper
+_atomic_write_json = atomic_write_json
 
 
 def _read_json(path):
@@ -144,17 +140,23 @@ class HeartbeatCoordinator:
 
     def beat(self):                          # spk: thread-entry
         """Re-lease this host's liveness (writer thread + round
-        arrivals both call this). The file write happens UNDER the
-        lock: both threads' temp files share one name (same pid), so
-        two interleaved atomic-rename sequences would race each other's
-        os.replace into FileNotFoundError."""
+        arrivals both call this). The lease record is snapshotted UNDER
+        the lock (seq/round/stopped are shared with the training
+        thread) but the file write happens OUTSIDE it:
+        atomic_write_json gives every call a unique temp name, so
+        concurrent beats cannot race each other's os.replace — and a
+        slow fsync (NFS can stall for hundreds of ms) no longer blocks
+        view()/gate() readers on the state lock (`sparknet lint`
+        SPK206). Two interleaved beats may land out of order; the loser
+        differs by one seq and a stamp milliseconds older — noise far
+        below lease_s, and the writer re-leases every interval_s."""
         with self._lock:
             if self._stopped:
                 return
             self._seq += 1
             rec = {"host": self.host, "seq": self._seq,
                    "round": self._round, "stamp": time.time()}
-            _atomic_write_json(self._hb_path(self.host), rec)
+        atomic_write_json(self._hb_path(self.host), rec)
 
     def announce_round(self, round_idx):
         """Post this host's arrival at ``round_idx`` (the rendezvous
@@ -191,7 +193,7 @@ class HeartbeatCoordinator:
                                else os.path.basename(p))
         for pat in ("part-*.npz", "mask-*.json", "delta-*.npz",
                     "delta-*.json", "consensus-*.npz", "consensus-*.json",
-                    "restart-*.json"):
+                    "restart-*.json", "*.tmp.*"):
             for p in glob.glob(os.path.join(glob.escape(self.dir), pat)):
                 try:
                     if now - os.path.getmtime(p) <= self.lease_s:
@@ -436,17 +438,15 @@ class FileConsensus:
 
     def _post(self, round_idx, leaves, valid, loss):
         path = self._part_path(self.coord.host, round_idx)
-        tmp = f"{path}.tmp.{os.getpid()}"
         meta = json.dumps({"host": self.coord.host, "round": int(round_idx),
                            "valid": int(bool(valid)),
                            "loss": float(loss)})
-        with open(tmp, "wb") as f:
-            np.savez(f, meta=np.frombuffer(meta.encode(), np.uint8),
-                     **{f"leaf{i}": np.asarray(a)
-                        for i, a in enumerate(leaves)})
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, path)
+        atomic_write_bytes(
+            path,
+            lambda f: np.savez(
+                f, meta=np.frombuffer(meta.encode(), np.uint8),
+                **{f"leaf{i}": np.asarray(a)
+                   for i, a in enumerate(leaves)}))
 
     def _load(self, host, round_idx, n_leaves):
         try:
@@ -486,7 +486,7 @@ class FileConsensus:
                 got = self._wait_parts(round_idx, set(alive) | {me},
                                        deadline)
                 mask = sorted(got)
-                _atomic_write_json(self._mask_path(round_idx),
+                atomic_write_json(self._mask_path(round_idx),
                                    {"round": int(round_idx),
                                     "included": mask, "authority": me})
                 return mask
@@ -617,14 +617,10 @@ class AsyncFileConsensus(FileConsensus):
         """Payload first, meta last — the meta's atomic rename commits
         the delta, so a reader that sees the meta can read the npz."""
         path = self._delta_npz(self.coord.host, v)
-        tmp = f"{path}.tmp.{os.getpid()}"
-        with open(tmp, "wb") as f:
-            np.savez(f, **{f"leaf{i}": np.asarray(a)
-                           for i, a in enumerate(leaves)})
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, path)
-        _atomic_write_json(self._delta_meta(self.coord.host, v),
+        atomic_write_bytes(
+            path, lambda f: np.savez(f, **{f"leaf{i}": np.asarray(a)
+                                           for i, a in enumerate(leaves)}))
+        atomic_write_json(self._delta_meta(self.coord.host, v),
                            {"host": self.coord.host, "version": int(v),
                             "valid": int(bool(valid)),
                             "loss": float(loss), "stamp": time.time()})
@@ -693,15 +689,11 @@ class AsyncFileConsensus(FileConsensus):
                              "loss": float(meta.get("loss",
                                                     float("nan"))),
                              "div_sq": div})
-        path = self._consensus_npz(v_ref)
-        tmp = f"{path}.tmp.{os.getpid()}"
-        with open(tmp, "wb") as f:
-            np.savez(f, **{f"leaf{i}": c.astype(np.float64)
-                           for i, c in enumerate(consensus)})
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, path)
-        _atomic_write_json(self._consensus_meta(v_ref),
+        atomic_write_bytes(
+            self._consensus_npz(v_ref),
+            lambda f: np.savez(f, **{f"leaf{i}": c.astype(np.float64)
+                                     for i, c in enumerate(consensus)}))
+        atomic_write_json(self._consensus_meta(v_ref),
                            {"version": int(v_ref),
                             "authority": self.coord.host,
                             "included": included,
@@ -851,8 +843,8 @@ def restart_barrier(coord, sha, timeout=30.0):
     loss so all survivors exit 4 holding the SAME resumable manifest —
     the supervisor relaunch then resumes one consistent world."""
     path = os.path.join(coord.dir, f"restart-{coord.host}.json")
-    _atomic_write_json(path, {"host": coord.host, "sha": sha,
-                              "stamp": time.time()})
+    atomic_write_json(path, {"host": coord.host, "sha": sha,
+                             "stamp": time.time()})
     deadline = time.time() + timeout
     while True:
         live = coord.alive_hosts()

@@ -1,0 +1,29 @@
+"""The canonical process exit-code table — ONE place, named constants.
+
+Supervisors (k8s restart policies, the DEPLOY.md runbook, smoke.sh)
+branch on these numbers, so a raw literal drifting in some call site is
+an operational bug: the supervisor reads "42" as watchdog-killed whether
+or not the code that exited meant that. `sparknet lint` SPK304 enforces
+that every ``sys.exit``/``os._exit`` call with a non-trivial code spells
+it through this table (0/1/2 are the universal Unix conventions and may
+stay literal).
+
+| code | name                | meaning                                    |
+|------|---------------------|--------------------------------------------|
+| 0    | EXIT_OK             | success                                    |
+| 1    | EXIT_FAILURE        | generic failure; lint findings             |
+| 2    | EXIT_USAGE          | bad usage / unreadable metrics or baseline |
+| 3    | EXIT_RECOVERY_ABORT | divergence recovery gave up (RecoveryAbort)|
+| 4    | EXIT_QUORUM_LOST    | too few live hosts for consensus           |
+| 42   | EXIT_WATCHDOG_STALL | watchdog killed a stalled run              |
+
+Adding a code: define the constant here, document it in DEPLOY.md, and
+teach the supervisor — SPK304 flags any literal it has never heard of.
+"""
+
+EXIT_OK = 0
+EXIT_FAILURE = 1
+EXIT_USAGE = 2
+EXIT_RECOVERY_ABORT = 3
+EXIT_QUORUM_LOST = 4
+EXIT_WATCHDOG_STALL = 42

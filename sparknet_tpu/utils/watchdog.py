@@ -17,6 +17,8 @@ import sys
 import threading
 import time
 
+from .exit_codes import EXIT_WATCHDOG_STALL
+
 
 class Watchdog:
     """Arm with expected step cadence; the training loop calls beat(loss).
@@ -127,10 +129,11 @@ class Watchdog:
                          else f"failed: {result.get('error')}"),
                       file=sys.stderr)
         if self.metrics is not None:
-            self.metrics.log("watchdog", kind="killed", exit_code=42,
+            self.metrics.log("watchdog", kind="killed",
+                             exit_code=EXIT_WATCHDOG_STALL,
                              emergency_snapshot_ok=ok)
             self.metrics.close()            # final flush before _exit
-        self._exit(42)
+        self._exit(EXIT_WATCHDOG_STALL)
 
     def stop(self):
         self._stop.set()

@@ -1,0 +1,151 @@
+"""SPK205-207 fixture corpus — the deadlock family. Parsed, never
+imported. Line numbers asserted in tests/test_lint.py."""
+
+import threading
+import time
+
+
+class TwoLocks:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def forward(self):
+        with self._a:
+            with self._b:                        # SPK205 cycle leg 1
+                pass
+
+    def backward(self):
+        with self._b:
+            with self._a:                        # cycle leg 2 (one report)
+                pass
+
+
+class Caller:
+    def __init__(self):
+        self._a = threading.Lock()
+        self.peer = Callee()
+
+    def poke_peer(self):
+        with self._a:
+            self.peer.work()                     # SPK205 cross-class cycle
+
+    def lock_a(self):
+        with self._a:
+            pass
+
+
+class Callee:
+    def __init__(self):
+        self._b = threading.Lock()
+        self.owner = Caller()
+
+    def work(self):
+        with self._b:
+            pass
+
+    def poke_owner(self):
+        with self._b:
+            self.owner.lock_a()                  # closes the cycle
+
+
+class Reentry:
+    def __init__(self):
+        self._m = threading.Lock()
+
+    def outer(self):
+        with self._m:
+            self.inner()                         # SPK205 self-deadlock
+
+    def inner(self):
+        with self._m:
+            pass
+
+
+class ReentrantOk:
+    def __init__(self):
+        self._m = threading.RLock()
+
+    def outer(self):
+        with self._m:
+            self.inner()                         # RLock: no finding
+
+    def inner(self):
+        with self._m:
+            pass
+
+
+class Ordered:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def one(self):
+        with self._a:
+            with self._b:                        # same order everywhere:
+                pass
+
+    def two(self):
+        with self._a:
+            with self._b:                        # no cycle, no finding
+                pass
+
+
+class SlowUnderLock:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self.v = 0
+
+    def direct(self):
+        with self._lock:
+            time.sleep(0.1)                      # SPK206 direct
+
+    def via_helper(self):
+        with self._lock:
+            self._flush()                        # SPK206 transitive
+
+    def _flush(self):
+        with open("state.json", "w") as f:
+            f.write("{}")
+
+    def waits(self):
+        with self._lock:
+            self._stop.wait(1.0)                 # SPK206 event wait
+
+    def snapshot_then_block(self):
+        with self._lock:
+            v = self.v
+        time.sleep(v)                            # outside: no finding
+
+    def tolerated(self):
+        with self._lock:
+            time.sleep(0.1)                      # spk: disable=SPK206
+
+
+class CondIdiom:
+    def __init__(self):
+        self._cv = threading.Condition()
+        self.ready = False
+
+    def waiter(self):
+        with self._cv:
+            while not self.ready:
+                self._cv.wait()                  # releases _cv: no finding
+
+
+class Emitter:
+    def __init__(self, on_tick):
+        self._lock = threading.Lock()
+        self.on_tick = on_tick
+        self.n = 0
+
+    def fire_bad(self):
+        with self._lock:
+            self.n += 1
+            self.on_tick(self.n)                 # SPK207
+
+    def fire_good(self):
+        with self._lock:
+            n = self.n
+        self.on_tick(n)                          # after release: no finding

@@ -1,0 +1,89 @@
+"""SPK301-304 fixture corpus — distributed file-protocol discipline.
+Parsed, never imported. Line numbers asserted in tests/test_lint.py."""
+
+import json
+import os
+import sys
+
+import numpy as np
+
+EXIT_RECOVERY_ABORT = 3
+MANIFEST_SUFFIX = ".latest.json"
+
+
+def bad_heartbeat(host, rec):
+    with open(f"hb-{host}.json", "w") as f:      # SPK301 (hb-)
+        json.dump(rec, f)
+
+
+def bad_part(h, r, arr):
+    np.savez(f"part-{h}-{r}.npz", arr=arr)       # SPK301 (part-)
+
+
+def bad_manifest(prefix, man):
+    path = prefix + MANIFEST_SUFFIX
+    with open(path, "w") as f:                   # SPK301 (constant)
+        json.dump(man, f)
+
+
+def _mask_path(round_idx):
+    return f"mask-{round_idx}.json"
+
+
+def bad_via_helper(round_idx, mask):
+    p = _mask_path(round_idx)
+    with open(p, "w") as f:                      # SPK301 (helper path)
+        json.dump(mask, f)
+
+
+def good_atomic(host, rec):
+    path = f"hb-{host}.json"
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:                    # tmp-tagged: no finding
+        json.dump(rec, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)                        # local src: no finding
+
+
+def good_reader(host):
+    with open(f"hb-{host}.json") as f:           # read mode: no finding
+        return json.load(f)
+
+
+def tolerated_write(host):
+    with open(f"hb-{host}.json", "w") as f:      # spk: disable=SPK301
+        f.write("{}")
+
+
+def split_commit(tmp_path, host):
+    os.replace(tmp_path, f"hb-{host}.json")      # SPK302 (src is a param)
+
+
+def bad_gate(hb, round_idx):
+    hb.gate(round_idx)                           # SPK303 (no timeout, dropped)
+
+
+def good_gate(hb, round_idx):
+    res = hb.gate(round_idx, timeout=30.0)       # consumed + bounded: ok
+    return res
+
+
+def bounded_barrier(hb, epoch):
+    hb.restart_barrier(epoch, timeout=60.0)      # timeout: no finding
+
+
+def tolerated_gate(hb, round_idx):
+    hb.gate(round_idx)                           # spk: disable=SPK303
+
+
+def bail_known():
+    sys.exit(3)                                  # SPK304 (EXIT_RECOVERY_ABORT)
+
+
+def bail_unknown():
+    os._exit(7)                                  # SPK304 (not in the table)
+
+
+def bail_named():
+    sys.exit(EXIT_RECOVERY_ABORT)                # named constant: no finding

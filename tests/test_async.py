@@ -480,7 +480,8 @@ class TestGhostReaping:
                            {"host": 1, "seq": 9, "round": 40,
                             "stamp": time.time() - 500})
         orphan = os.path.join(str(tmp_path), "delta-1-40.npz")
-        open(orphan, "wb").write(b"ghost")
+        # deliberately torn: the crashed-peer garbage the reaper is for
+        open(orphan, "wb").write(b"ghost")    # spk: disable=SPK301
         os.utime(orphan, (time.time() - 500,) * 2)
         c = _coord(tmp_path, 0, 2, metrics=ms).start()
         try:

@@ -1,0 +1,149 @@
+"""Runtime regression for the generated metrics event registry.
+
+sparknet_tpu/obs/event_schema.py is GENERATED (``python -m
+sparknet_tpu lint --write-event-schema``) from every ``metrics.log``
+emit site in the repo. These tests pin three invariants at runtime —
+independent of the lint engine — so a typo'd consumer or a stale
+schema fails CI even if someone runs pytest without the lint gate:
+
+  1. the committed schema matches what the tree actually emits
+     (same freshness check scripts/lint.sh phase 1 performs),
+  2. every event name the consumers (obs/report.py, obs/monitor.py)
+     filter on exists in the registry,
+  3. a seeded typo'd consumer is caught by BOTH the runtime checker
+     and lint rule SPK401 — the two enforcement paths can't silently
+     diverge.
+"""
+
+import ast
+import os
+
+from sparknet_tpu.obs import event_schema
+from sparknet_tpu.analysis import lint_paths
+from sparknet_tpu.analysis.metrics_rules import (
+    build_registry, iter_consumer_checks, load_schema, schema_path)
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+OBS = os.path.join(REPO, "sparknet_tpu", "obs")
+
+CONSUMERS = ("report.py", "monitor.py")
+
+# sentinel defaults consumers use for "row without an event field"
+SENTINELS = {"", "?"}
+
+
+def consumed_names(source):
+    """(domain, name) pairs a consumer module filters on, via the same
+    walker the lint rule uses."""
+    tree = ast.parse(source)
+    return [(domain, name)
+            for _node, domain, name in iter_consumer_checks(tree)]
+
+
+class TestSchemaFreshness:
+    def test_committed_schema_matches_emit_sites(self):
+        live = build_registry(REPO)
+        committed = load_schema()
+        assert committed is not None, (
+            "sparknet_tpu/obs/event_schema.py missing — regenerate "
+            "with: python -m sparknet_tpu lint --write-event-schema")
+        assert committed["events"] == {
+            name: {"fields": info["fields"], "open": info["open"]}
+            for name, info in live["events"].items()
+        }, "event_schema.py is stale — regenerate it"
+        assert committed["kinds"] == set(live["kinds"])
+        assert committed["kinds_open"] == live["kinds_open"]
+
+    def test_module_constants_agree_with_loader(self):
+        # the importable module and the lint-side loader must expose
+        # the same registry (loader parses the file, never imports it)
+        committed = load_schema()
+        assert set(event_schema.EVENTS) == set(committed["events"])
+        assert set(event_schema.KINDS) == committed["kinds"]
+        assert event_schema.KINDS_OPEN == committed["kinds_open"]
+
+    def test_core_training_events_registered(self):
+        for name in ("step", "round", "checkpoint", "recovery",
+                     "watchdog", "summary"):
+            assert name in event_schema.EVENTS, name
+
+
+class TestConsumersUseRegisteredNames:
+    def test_consumer_event_filters_are_registered(self):
+        known = set(event_schema.EVENTS) | SENTINELS
+        for fname in CONSUMERS:
+            with open(os.path.join(OBS, fname), encoding="utf-8") as f:
+                src = f.read()
+            for domain, name in consumed_names(src):
+                if domain != "event":
+                    continue
+                assert name in known, (
+                    f"obs/{fname} filters on event {name!r} that "
+                    f"nothing emits — typo, or regenerate the schema")
+
+    def test_consumer_kind_filters_are_registered(self):
+        if event_schema.KINDS_OPEN:
+            # chaos.py forwards a dynamic kind=, so the kind
+            # vocabulary is honestly open; membership can't be
+            # asserted repo-wide (the closed-set path is exercised
+            # by test_seeded_typo below and the lint fixtures)
+            return
+        known = set(event_schema.KINDS) | SENTINELS
+        for fname in CONSUMERS:
+            with open(os.path.join(OBS, fname), encoding="utf-8") as f:
+                src = f.read()
+            for domain, name in consumed_names(src):
+                if domain == "kind":
+                    assert name in known, (fname, name)
+
+
+SEEDED_TYPO = '''\
+def watch(rows):
+    # "host_alivee" is a seeded typo: host_alive is the real event
+    return [e for e in rows if e.get("event") == "host_alivee"]
+'''
+
+
+class TestSeededTypoCaughtBothWays:
+    def test_runtime_checker_catches_typo(self):
+        known = set(event_schema.EVENTS) | SENTINELS
+        bad = [name for domain, name in consumed_names(SEEDED_TYPO)
+               if domain == "event" and name not in known]
+        assert bad == ["host_alivee"]
+
+    def test_lint_rule_catches_typo(self, tmp_path):
+        p = tmp_path / "seeded_consumer.py"
+        p.write_text(SEEDED_TYPO)
+        findings = lint_paths([str(p)], root=str(tmp_path),
+                              select={"SPK401"})
+        assert [f.code for f in findings] == ["SPK401"]
+        assert "host_alivee" in findings[0].message
+
+    def test_closed_kind_vocabulary_enforced(self, tmp_path,
+                                             monkeypatch):
+        """With a closed-KINDS schema in force, a typo'd kind filter
+        trips SPK401 too (the live repo's KINDS are open, so this
+        pins the closed path via a synthetic schema)."""
+        import sparknet_tpu.analysis.metrics_rules as mr
+        schema = tmp_path / "event_schema.py"
+        schema.write_text(
+            "EVENTS = {'step': {'fields': ['loss'], 'open': False}}\n"
+            "KINDS = ['nan', 'stall']\n"
+            "KINDS_OPEN = False\n")
+        monkeypatch.setattr(mr, "schema_path",
+                            lambda: str(schema))
+        p = tmp_path / "consumer.py"
+        p.write_text(
+            "def f(rows):\n"
+            "    return [e for e in rows"
+            " if e.get('kind') == 'stal']\n")
+        findings = lint_paths([str(p)], root=str(tmp_path),
+                              select={"SPK401"})
+        assert [f.code for f in findings] == ["SPK401"]
+        assert "stal" in findings[0].message
+
+
+def test_schema_path_points_at_committed_file():
+    assert os.path.abspath(schema_path()) == os.path.abspath(
+        os.path.join(OBS, "event_schema.py"))

@@ -34,7 +34,9 @@ def code_lines(findings):
 def mk_args(**kw):
     base = dict(paths=[], strict=False, baseline=None,
                 write_baseline=False, justification=None, select=None,
-                root=None, json=False, verbose=False, list_rules=False)
+                root=None, json=False, verbose=False, list_rules=False,
+                exclude=[], jobs=1, cache=False,
+                write_event_schema=False)
     base.update(kw)
     return argparse.Namespace(**base)
 
@@ -331,7 +333,10 @@ class TestCLI:
         assert cli_main(["lint", "--list-rules"]) == 0
         out = capsys.readouterr().out
         for code in ("SPK101", "SPK102", "SPK103", "SPK104", "SPK105",
-                     "SPK201", "SPK202", "SPK203", "SPK204"):
+                     "SPK201", "SPK202", "SPK203", "SPK204",
+                     "SPK205", "SPK206", "SPK207",
+                     "SPK301", "SPK302", "SPK303", "SPK304",
+                     "SPK401", "SPK402"):
             assert code in out
 
     def test_missing_path_is_usage_error(self, tmp_path, capsys):
@@ -365,7 +370,314 @@ class TestSelfLint:
         rule family, so a rule silently breaking shows up here."""
         codes = set()
         for fname in ("jax_hazards.py", "prng.py", "axes.py",
-                      "locks.py"):
+                      "locks.py", "deadlock.py", "protocol.py",
+                      "events.py"):
             codes |= {f.code for f in fixture_findings(fname)}
         assert {"SPK101", "SPK102", "SPK103", "SPK104", "SPK105",
-                "SPK201", "SPK202", "SPK203", "SPK204"} <= codes
+                "SPK201", "SPK202", "SPK203", "SPK204",
+                "SPK205", "SPK206", "SPK207",
+                "SPK301", "SPK302", "SPK303", "SPK304",
+                "SPK401", "SPK402"} <= codes
+
+
+# ------------------------------------------------- cross-module corpus
+
+class TestDeadlockRuleCorpus:
+    def test_deadlock_corpus(self):
+        got = code_lines(fixture_findings("deadlock.py"))
+        assert got == sorted([
+            ("SPK205", 15),      # same-class opposite nest order
+            ("SPK205", 31),      # cross-class cycle via attr_types
+            ("SPK205", 58),      # plain-Lock re-entry through helper
+            ("SPK206", 102),     # time.sleep under self._lock
+            ("SPK206", 106),     # open() two calls deep, lock held
+            ("SPK206", 114),     # Event.wait() under the lock
+            ("SPK207", 146),     # stored callback fired under lock
+        ])
+
+    def test_deadlock_negatives_quiet(self):
+        for f in fixture_findings("deadlock.py"):
+            assert not f.symbol.startswith("ReentrantOk")   # RLock
+            assert not f.symbol.startswith("Ordered")       # one order
+            assert not f.symbol.startswith("CondIdiom")     # cv.wait
+            assert f.symbol != "SlowUnderLock.snapshot_then_block"
+            assert f.symbol != "Emitter.fire_good"
+            assert f.line != 122                            # disable=
+
+
+class TestProtocolRuleCorpus:
+    def test_protocol_corpus(self):
+        got = code_lines(fixture_findings("protocol.py"))
+        assert got == sorted([
+            ("SPK301", 15),      # hb- f-string path, raw open
+            ("SPK301", 20),      # part- np.savez, no tmp/replace
+            ("SPK301", 25),      # marker via module constant concat
+            ("SPK301", 35),      # marker through _mask_path helper
+            ("SPK302", 60),      # os.replace src is a parameter
+            ("SPK303", 64),      # bare gate() without timeout=
+            ("SPK304", 81),      # sys.exit(3): name the table entry
+            ("SPK304", 85),      # os._exit(7): no canonical name
+        ])
+
+    def test_protocol_negatives_quiet(self):
+        syms = {f.symbol for f in fixture_findings("protocol.py")}
+        for ok in ("good_atomic", "good_reader", "good_gate",
+                   "bounded_barrier", "bail_named",
+                   "tolerated_write", "tolerated_gate"):
+            assert ok not in syms
+
+    def test_spk304_names_canonical_constant(self):
+        by_line = {f.line: f for f in fixture_findings("protocol.py")}
+        assert "EXIT_RECOVERY_ABORT" in by_line[81].message
+
+
+class TestEventsRuleCorpus:
+    def test_events_corpus(self):
+        got = code_lines(fixture_findings("events.py"))
+        assert got == sorted([
+            ("SPK402", 16),      # emit of an unregistered event
+            ("SPK402", 20),      # registered event, drifted field
+            ("SPK401", 26),      # consumer filters typo'd event
+            ("SPK401", 31),      # typo inside a tuple comparator
+        ])
+
+    def test_events_negatives_quiet(self):
+        lines = {f.line for f in fixture_findings("events.py")}
+        assert 37 not in lines                     # disable=SPK401
+        for f in fixture_findings("events.py"):
+            assert f.symbol != "local_kind_ok"
+
+
+# --------------------------------------------------------- project index
+
+def _project_index(tmp_path, files):
+    from sparknet_tpu.analysis.engine import Module
+    from sparknet_tpu.analysis.project import ProjectIndex
+    mods = []
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+        mods.append(Module.load(str(p), str(tmp_path)))
+    return ProjectIndex(mods), {m.relpath: m for m in mods}
+
+
+class TestProjectIndex:
+    def test_call_edges_resolve_across_modules(self, tmp_path):
+        proj, mods = _project_index(tmp_path, {
+            "pkg/__init__.py": "",
+            "pkg/b.py": """\
+                import time
+                def helper():
+                    time.sleep(1)
+            """,
+            "pkg/a.py": """\
+                from .b import helper
+                class A:
+                    def __init__(self):
+                        self.peer = B()
+                    def run(self):
+                        helper()
+                        self.go()
+                        self.peer.pong()
+                    def go(self):
+                        pass
+                class B:
+                    def pong(self):
+                        pass
+            """,
+        })
+        import ast
+        fn = proj.functions[("pkg/a.py", "A.run")]
+        mod = mods["pkg/a.py"]
+        keys = set()
+        for node in ast.walk(fn.node):
+            if isinstance(node, ast.Call):
+                tgt = proj.resolve_call(node, mod, fn.node)
+                if tgt is not None:
+                    keys.add(tgt.key)
+        assert ("pkg/b.py", "helper") in keys          # imported name
+        assert ("pkg/a.py", "A.go") in keys            # self.method()
+        assert ("pkg/a.py", "B.pong") in keys          # self.field.m()
+
+    def test_blocking_propagates_transitively(self, tmp_path):
+        proj, mods = _project_index(tmp_path, {
+            "pkg/__init__.py": "",
+            "pkg/b.py": """\
+                import time
+                def helper():
+                    time.sleep(1)
+            """,
+            "pkg/a.py": """\
+                from .b import helper
+                def outer():
+                    helper()
+                def pure():
+                    return 1
+            """,
+        })
+        assert proj.transitively_blocking(
+            ("pkg/a.py", "outer")) is not None
+        assert proj.transitively_blocking(
+            ("pkg/a.py", "pure")) is None
+
+    def test_expr_fragments_through_helper_and_join(self, tmp_path):
+        import ast
+        proj, mods = _project_index(tmp_path, {
+            "m.py": """\
+                import os
+                SUFFIX = ".latest.json"
+                def man(prefix):
+                    return prefix + SUFFIX
+                def use(prefix):
+                    p = man(prefix)
+                    q = os.path.join("root", f"part-{prefix}.npz")
+                    return p, q
+            """,
+        })
+        use = proj.functions[("m.py", "use")].node
+        frags = {}
+        for node in ast.walk(use):
+            if isinstance(node, ast.Assign) and \
+                    isinstance(node.targets[0], ast.Name):
+                frags[node.targets[0].id] = "".join(
+                    proj.expr_fragments(node.value, mods["m.py"],
+                                        use))
+        assert ".latest.json" in frags["p"]   # const through helper ret
+        assert "part-" in frags["q"]          # os.path.join + f-string
+
+    def test_constants_ambiguity_and_exit_table(self, tmp_path):
+        proj, _ = _project_index(tmp_path, {
+            "a.py": "TAG = 'alpha'\nEXIT_BOOM = 9\n",
+            "b.py": "TAG = 'beta'\nONLY = 'one'\n",
+        })
+        assert proj.resolve_constant("TAG") is None     # ambiguous
+        assert proj.resolve_constant("ONLY") == "one"
+        assert proj.exit_table[9] == "EXIT_BOOM"
+
+    def test_emit_registry_collects_fields(self, tmp_path):
+        proj, _ = _project_index(tmp_path, {
+            "m.py": """\
+                EVT = "boot"
+                def f(metrics):
+                    metrics.log(EVT, a=1, b=2)
+                    metrics.log("boot", c=3)
+            """,
+        })
+        assert "boot" in proj.events
+        assert {"a", "b", "c"} <= proj.events["boot"]["fields"]
+
+
+# ----------------------------------------------- profiles, cache, jobs
+
+class TestCLIFeatures:
+    def test_tests_profile_expands(self, capsys):
+        # @tests excludes the concurrency families: deadlock.py is
+        # silent under it, protocol.py still fires SPK301/303/304
+        assert fixture_findings(
+            "deadlock.py",
+            select={"SPK001", "SPK301", "SPK302", "SPK304"}) == []
+        rc = cli_main(["lint", os.path.join(FIXTURES, "protocol.py"),
+                       "--root", FIXTURES, "--select", "@tests"])
+        assert rc == 1
+        out = capsys.readouterr().out
+        assert "SPK301" in out and "SPK304" in out
+        assert "SPK303" not in out          # not in the @tests profile
+
+    def test_unknown_profile_is_usage_error(self, tmp_path):
+        p = tmp_path / "ok.py"
+        p.write_text(CLEAN_SRC)
+        assert cli_main(["lint", str(p), "--select", "@bogus"]) == 2
+
+    def test_exclude_skips_matching_paths(self, tmp_path):
+        (tmp_path / "fixtures").mkdir()
+        (tmp_path / "fixtures" / "bad.py").write_text(BAD_SRC)
+        (tmp_path / "ok.py").write_text(CLEAN_SRC)
+        rc = cli_main(["lint", str(tmp_path), "--root", str(tmp_path),
+                       "--exclude", "fixtures"])
+        assert rc == 0
+
+    def test_cache_round_trip_and_invalidation(self, tmp_path,
+                                               capsys):
+        p = tmp_path / "mod.py"
+        p.write_text(BAD_SRC)
+        argv = ["lint", str(tmp_path), "--root", str(tmp_path),
+                "--cache", "--json"]
+        assert cli_main(argv) == 1
+        cold = json.loads(capsys.readouterr().out)
+        cache = tmp_path / ".sparknet-lint-cache.json"
+        assert cache.exists()
+        assert cli_main(argv) == 1           # warm: served from cache
+        warm = json.loads(capsys.readouterr().out)
+        assert warm["findings"] == cold["findings"]
+        p.write_text(CLEAN_SRC)              # content hash changes
+        assert cli_main(argv) == 0
+
+    def test_jobs_matches_serial(self):
+        from sparknet_tpu.analysis.engine import LintEngine
+        serial = LintEngine(jobs=1).run([FIXTURES], root=FIXTURES)
+        pooled = LintEngine(jobs=2).run([FIXTURES], root=FIXTURES)
+        assert code_lines(serial) == code_lines(pooled)
+        assert serial  # the corpus is not empty
+
+    def test_write_event_schema_regenerates(self, tmp_path, capsys):
+        out_path = tmp_path / "event_schema.py"
+        from sparknet_tpu.analysis.metrics_rules import (
+            write_event_schema, load_schema)
+        write_event_schema(REPO, out_path=str(out_path))
+        text = out_path.read_text()
+        assert "EVENTS = {" in text and "'step'" in text
+        committed = load_schema()
+        ns = {}
+        exec(compile(text, str(out_path), "exec"), ns)
+        assert ns["EVENTS"] == committed["events"]
+
+
+class TestSeededViolations:
+    """Acceptance: a seeded violation of each new family fails the
+    CLI with its rule code in the output."""
+
+    def _run(self, tmp_path, src, argv_extra=()):        # -> (rc, out)
+        p = tmp_path / "seeded.py"
+        p.write_text(textwrap.dedent(src))
+        import io, contextlib
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            rc = cli_main(["lint", str(p), "--root", str(tmp_path),
+                           "--strict", *argv_extra])
+        return rc, buf.getvalue()
+
+    def test_seeded_deadlock_cycle(self, tmp_path):
+        rc, out = self._run(tmp_path, """\
+            import threading
+            class T:
+                def __init__(self):
+                    self._a = threading.Lock()
+                    self._b = threading.Lock()
+                def fwd(self):
+                    with self._a:
+                        with self._b:
+                            pass
+                def rev(self):
+                    with self._b:
+                        with self._a:
+                            pass
+        """)
+        assert rc == 1 and "SPK205" in out
+
+    def test_seeded_nonatomic_rendezvous_write(self, tmp_path):
+        rc, out = self._run(tmp_path, """\
+            import json
+            def beat(d, payload):
+                with open(d + "/hb-0.json", "w") as f:
+                    json.dump(payload, f)
+        """)
+        assert rc == 1 and "SPK301" in out
+
+    def test_seeded_unknown_event_consumer(self, tmp_path):
+        rc, out = self._run(tmp_path, """\
+            def consume(rows):
+                return [e for e in rows
+                        if e.get("event") == "step_summry"]
+        """)
+        assert rc == 1 and "SPK401" in out
