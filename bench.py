@@ -278,6 +278,134 @@ def bench_transformer_lm(peak, seq_len=4096, batch=4, d_model=512,
     return row
 
 
+# --------------------------------------------------------------- ablations
+
+# lever -> (env var, baseline arm value, lever arm value). Each lever's
+# natural workload is the row it is supposed to move (ISSUE/PERF.md):
+# epilogue -> googlenet b256 (the one 3-op conv+relu+lrn site lives in
+# its conv2 tower), scan/remat -> the d512x6 LM row (per-layer dispatch
+# overhead), overlap -> data-parallel caffenet (the grad allreduce).
+ABLATE_ENVS = {
+    "epilogue": ("SPARKNET_EPILOGUE", "off", "on"),
+    "scan": ("SPARKNET_SCAN", "off", "on"),
+    "remat": ("SPARKNET_REMAT", "none", "dots"),
+    "overlap": ("SPARKNET_OVERLAP", "off", "on"),
+}
+
+
+def run_ablation(lever, peak, emit):
+    """--ablate LEVER: paired baseline/lever rows from ONE process.
+
+    Both arms trace under their own env value (the knobs are read at
+    trace time), then the timed windows INTERLEAVE arms — the
+    experiments/ab_s2d.py discipline — so chip-contention drift lands on
+    both arms equally and the delta is the lever's, not the hour's. Rows
+    carry {"ablation": lever, "arm": ...} for A/B provenance in
+    bench_metrics.jsonl."""
+    import os
+    import jax.numpy as jnp
+    from sparknet_tpu.models import zoo
+    env, off_v, on_v = ABLATE_ENVS[lever]
+    rs = np.random.RandomState(0)
+    # SPARKNET_BENCH_TINY=1: shrink every workload to smoke-test the
+    # A/B plumbing off-TPU (CI, laptops). Rows still carry the device
+    # kind from bench_config, so tiny CPU rows can't impersonate TPU
+    # measurements.
+    tiny = bool(os.environ.get("SPARKNET_BENCH_TINY"))
+
+    if lever in ("scan", "remat"):
+        seq, d, nl, vocab, batch = (128, 64, 3, 256, 2) if tiny \
+            else (4096, 512, 6, 8192, 4)
+        toks = rs.randint(0, vocab, (batch, seq))
+        batch_d = {"data": jnp.asarray(toks, jnp.int32),
+                   "label": jnp.asarray((toks + 1) % vocab, jnp.int32)}
+        unit, unit_key = batch * seq * ITERS, "tokens_per_sec"
+        fixed_flops = 3 * 2 * (nl * (12 * d ** 2 + seq * d) + d * vocab)
+        base = {"model": "transformer_lm", "batch": batch, "seq_len": seq,
+                "d_model": d, "num_layers": nl}
+
+        def mk():
+            return _mk_solver(zoo.transformer_lm(
+                vocab_size=vocab, seq_len=seq, batch_size=batch,
+                d_model=d, num_layers=nl, num_heads=8, flash=True),
+                compute_dtype=jnp.bfloat16)
+    elif lever == "epilogue":
+        batch, side, classes = (8, 32, 10) if tiny else (256, 224, 1000)
+        batch_d = {"data": jnp.asarray(rs.randn(batch, 3, side, side),
+                                       jnp.bfloat16),
+                   "label": jnp.asarray(rs.randint(0, classes, batch),
+                                        jnp.int32)}
+        unit, unit_key = batch * ITERS, "images_per_sec"
+        fixed_flops = None          # per-arm, from the solver's graph
+        base = {"model": "cifar10_full" if tiny else "googlenet",
+                "batch": batch}
+
+        def mk():
+            if tiny:                # conv/relu fusion sites without the
+                return _mk_solver(  # 27M-param googlenet build time
+                    zoo.cifar10_full(batch_size=batch))
+            return _mk_solver(zoo.googlenet(batch_size=batch,
+                                            num_classes=1000))
+    else:                           # overlap: DP caffenet, grads allreduce
+        from sparknet_tpu.parallel import DataParallelSolver
+        from sparknet_tpu.proto import Message
+        batch, side, classes = (16, 28, 10) if tiny else (256, 227, 1000)
+        batch_d = {"data": jnp.asarray(rs.randn(batch, 1 if tiny else 3,
+                                                side, side), jnp.bfloat16),
+                   "label": jnp.asarray(rs.randint(0, classes, batch),
+                                        jnp.int32)}
+        unit, unit_key = batch * ITERS, "images_per_sec"
+        fixed_flops = None
+        base = {"model": "lenet_dp" if tiny else "caffenet_dp",
+                "batch": batch}
+
+        def mk():
+            sp = Message("SolverParameter", base_lr=0.01,
+                         lr_policy="fixed", momentum=0.9,
+                         weight_decay=0.0005, display=0, random_seed=0)
+            net = zoo.lenet(batch_size=batch) if tiny \
+                else zoo.caffenet(batch_size=batch, num_classes=1000)
+            return DataParallelSolver(sp, net_param=net)
+
+    arms = {}
+    for arm, val in (("baseline", off_v), (lever, on_v)):
+        old = os.environ.get(env)
+        os.environ[env] = val
+        try:
+            s = mk()
+            for _ in range(WARMUP):     # first step traces under `val`
+                loss = s.train_step(batch_d)
+            float(loss)
+            arms[arm] = (s, val)
+        finally:
+            os.environ.pop(env, None)
+            if old is not None:
+                os.environ[env] = old
+
+    dts = {a: [] for a in arms}
+    for _ in range(WINDOWS):
+        for a, (s, _v) in arms.items():
+            t0 = time.perf_counter()
+            for _ in range(ITERS):
+                out = s.train_step(batch_d)
+            float(out)
+            dts[a].append(time.perf_counter() - t0)
+
+    for a, (s, val) in arms.items():
+        flops = fixed_flops if fixed_flops is not None \
+            else model_train_flops_per_image(s)
+        rate = unit / min(dts[a])
+        row = dict(base, mode="ablation", ablation=lever, arm=a)
+        row[env] = val
+        row[unit_key] = round(rate, 1)
+        row[unit_key + "_spread"] = _rate_stats(unit, dts[a])
+        row["model_tflops_per_sec"] = round(rate * flops / 1e12, 2)
+        if peak:
+            row["mfu"] = round(rate * flops / peak, 4)
+        emit(row)
+    return 0
+
+
 # --------------------------------------------------- multi-chip projection
 
 # Ring-allreduce cost model: a pmean of B bytes over N peers moves
@@ -373,6 +501,11 @@ def main():
     ap.add_argument("--project", action="store_true",
                     help="print the analytic multi-chip projection from "
                          "the measured single-chip rows and exit")
+    ap.add_argument("--ablate", choices=sorted(ABLATE_ENVS),
+                    help="run ONE paired baseline/lever A/B for a perf "
+                         "lever (same process, interleaved windows) and "
+                         "exit; rows land in --metrics and "
+                         "bench_ablation.json with ablation provenance")
     ap.add_argument("--details", default="bench_details.json")
     ap.add_argument("--chips", type=int, nargs="+", default=[2, 4, 8, 32])
     ap.add_argument("--ici-gbps", type=float, default=ICI_GBPS)
@@ -405,19 +538,31 @@ def main():
                  platform=dev.platform, peak_bf16_flops=peak,
                  windows=WINDOWS, warmup=WARMUP, iters_per_window=ITERS)
 
+    # ablation A/Bs get their own details file: a lever smoke run must
+    # never clobber the committed full-run bench_details.json artifact
+    details_path = args.details
+    if args.ablate and details_path == "bench_details.json":
+        details_path = "bench_ablation.json"
+
     def emit(row):
         # stream rows as they finish: a killed/timed-out run still leaves
-        # every completed measurement on stderr and in bench_details.json
+        # every completed measurement on stderr and in the details file
         # (written atomically so a mid-write kill can't truncate it)
         import os
         rows.append(row)
         print("#BENCH " + json.dumps(row), file=sys.stderr, flush=True)
         if mlog:
             mlog.log("bench", **row)
-        with open("bench_details.json.tmp", "w") as f:
+        with open(details_path + ".tmp", "w") as f:
             json.dump({"device": dev.device_kind, "platform": dev.platform,
                        "peak_bf16_flops": peak, "rows": rows}, f, indent=1)
-        os.replace("bench_details.json.tmp", "bench_details.json")
+        os.replace(details_path + ".tmp", details_path)
+
+    if args.ablate:
+        rc = run_ablation(args.ablate, peak, emit)
+        if mlog:
+            mlog.close()
+        return rc
 
     # headline: CaffeNet batch 256, synthetic-fed (the reference workload).
     # The driver's ONE JSON line prints immediately — supplementary rows

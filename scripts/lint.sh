@@ -41,7 +41,16 @@ python -m sparknet_tpu lint --strict --cache --jobs "$JOBS" \
     --baseline .sparknet-lint-baseline.json \
     --root . sparknet_tpu
 
-# 3. relaxed per-tree profiles (the shared baseline stays empty)
+# 3. donation guard: SPK105 (missing buffer donation on an update jit)
+#    must stay at ZERO findings repo-wide — every solver family donates
+#    params/state/history, and new code keeps it that way. No baseline:
+#    a single regression fails CI. (tests/fixtures holds the rule's own
+#    intentional positive and is excluded everywhere.)
+python -m sparknet_tpu lint --strict --cache --jobs "$JOBS" \
+    --select SPK105 --exclude fixtures \
+    --root . sparknet_tpu tests scripts experiments bench.py
+
+# 4. relaxed per-tree profiles (the shared baseline stays empty)
 python -m sparknet_tpu lint --strict --cache --jobs "$JOBS" \
     --select @tests --exclude fixtures \
     --baseline .sparknet-lint-baseline.json \

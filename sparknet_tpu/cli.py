@@ -129,6 +129,7 @@ def cmd_train(args):
     # one metrics stream + span tracer for the whole run: the solver's
     # step/comms accounting, the prefetch gauges, and the CLI's phase
     # spans all land in the same JSONL (see sparknet_tpu.obs)
+    _apply_perf_flags(args)   # before any net is compiled
     metrics = MetricsLogger(args.metrics) if args.metrics else None
     tracer = Tracer(metrics)
     if args.chaos:
@@ -489,6 +490,7 @@ def cmd_time(args):
 
 def cmd_cifar(args):
     from .apps import CifarApp
+    _apply_perf_flags(args)   # before app/solver construction
     if args.chaos:
         # arm BEFORE app/solver construction so active_chaos() sees it
         from .resilience.chaos import ChaosMonkey, install_chaos
@@ -534,6 +536,7 @@ def cmd_lm(args):
 
     if args.snapshot_every and not args.snapshot_prefix:
         raise SystemExit("--snapshot-every needs --snapshot-prefix")
+    _apply_perf_flags(args)   # before any solver traces the net
     sp = Message("SolverParameter", base_lr=args.lr, lr_policy="fixed",
                  display=args.display, type=args.solver_type,
                  random_seed=args.seed,
@@ -744,6 +747,36 @@ def cmd_monitor(args):
         os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
         return 0
     return 0 if state.events else 2
+
+
+def _add_perf_flags(p, scan=False):
+    """--remat (and for the LM driver --scan): the trace-time perf knobs
+    of graph/compiler.py. The flags write the SPARKNET_* env vars before
+    any solver is constructed, so the env vars stay the back-compat
+    fallback (SPARKNET_REMAT=0/1 still means none/full) and every code
+    path — including nets built by apps — sees one consistent policy."""
+    p.add_argument("--remat", choices=("none", "dots", "full"),
+                   default=None,
+                   help="rematerialization policy for the train trace: "
+                        "none (store everything), dots (checkpoint_dots "
+                        "— keep matmul outputs, recompute elementwise), "
+                        "full (recompute whole segments). Default: "
+                        "SPARKNET_REMAT env var, else none")
+    if scan:
+        p.add_argument("--scan", choices=("auto", "on", "off"),
+                       default=None,
+                       help="scan-over-layers for isomorphic block "
+                            "stacks: one traced body + lax.scan instead "
+                            "of N unrolled copies (auto: TPU only). "
+                            "Default: SPARKNET_SCAN env var, else auto")
+
+
+def _apply_perf_flags(args):
+    import os
+    if getattr(args, "remat", None) is not None:
+        os.environ["SPARKNET_REMAT"] = args.remat
+    if getattr(args, "scan", None) is not None:
+        os.environ["SPARKNET_SCAN"] = args.scan
 
 
 def _add_heartbeat_flags(p):
@@ -972,6 +1005,7 @@ def main(argv=None):
     t.add_argument("--recover-explode-factor", type=float, default=0.0,
                    help=">0: also roll back when the loss exceeds this "
                         "factor times its recent healthy EMA")
+    _add_perf_flags(t)
     t.add_argument("--chaos", metavar="SPEC",
                    help="deterministic fault injection, e.g. "
                         "'nan_step=30,io_p=0.02,sigterm_round=3,seed=1' "
@@ -1096,6 +1130,7 @@ def main(argv=None):
                         "simulate a straggler, or "
                         "'kill_worker=1,kill_round=3' to crash a worker "
                         "mid-run; also via SPARKNET_CHAOS)")
+    _add_perf_flags(c)
     _add_health_flags(c)
     _add_elastic_flags(c)
     _add_heartbeat_flags(c)
@@ -1134,6 +1169,7 @@ def main(argv=None):
                     help="N>1: run the trunk as an N-stage GPipe pipeline "
                          "over a pipe mesh axis (PipelineLMSolver)")
     lm.add_argument("--microbatches", type=int, default=0)
+    _add_perf_flags(lm, scan=True)
     lm.add_argument("--metrics", help="JSONL loss-curve output path")
     lm.add_argument("--snapshot-every", type=int, default=0)
     lm.add_argument("--snapshot-prefix")

@@ -30,6 +30,32 @@ from .. import ops as _ops  # noqa: F401
 
 TRAIN, TEST = 0, 1
 
+REMAT_POLICIES = ("none", "dots", "full")
+
+
+def _env_remat():
+    """SPARKNET_REMAT -> policy name. Back-compat: "0"/"1" mean
+    none/full (the original boolean env var)."""
+    import os
+    v = os.environ.get("SPARKNET_REMAT", "").lower()
+    pol = {"": "none", "0": "none", "none": "none",
+           "1": "full", "full": "full", "dots": "dots"}.get(v)
+    if pol is None:
+        raise ValueError(
+            f"SPARKNET_REMAT={v!r}: want none|dots|full (or 0/1)")
+    return pol
+
+
+def _checkpointed(fn, pol):
+    """Wrap fn in jax.checkpoint under the named remat policy: "full"
+    recomputes everything in the backward, "dots" saves matmul/conv
+    outputs and recomputes the cheap elementwise tails (the standard
+    memory/FLOPs middle ground for transformer blocks)."""
+    if pol == "dots":
+        return jax.checkpoint(
+            fn, policy=jax.checkpoint_policies.checkpoint_dots)
+    return jax.checkpoint(fn)
+
 
 def upgrade_v1(net_param):
     """Upgrade legacy V1 'layers' to V2 'layer' entries (the capability of
@@ -247,6 +273,13 @@ class CompiledNet:
         # net outputs: produced and never consumed (net.cpp:270-284)
         self.output_blobs = [b for b, l in available.items()
                              if l != "__input__"]
+        # perf knobs, settable per-net (Solver.set_remat / CLI --remat);
+        # None defers to the SPARKNET_REMAT / SPARKNET_SCAN env vars at
+        # trace time
+        self.remat = None
+        self.scan = None
+        self._scan_cache = None
+        self._epilogue_cache = None
 
     # -- feeds -------------------------------------------------------------
     def feed_blobs(self):
@@ -319,12 +352,80 @@ class CompiledNet:
         self._remat_cache = groups
         return groups
 
+    def _epilogue_plan(self):
+        """Fusable conv-epilogue sites, cached: {conv_idx: (relu_idx,
+        lrn_idx | None)}.
+
+        A site is Convolution (bias_term, single top) immediately
+        followed by a zero-slope in-place ReLU on the same blob — the
+        zoo/prototxt idiom — optionally followed by an adjacent 4D
+        ACROSS_CHANNELS LRN reading that blob. The LRN extension only
+        qualifies when nothing ELSE reads the relu'd blob (no later
+        consumer, no loss weight, not a net output): the fused kernel
+        never materializes it, and the remat discipline applies — absent,
+        never stale."""
+        if self._epilogue_cache is not None:
+            return self._epilogue_cache
+        plan = {}
+        nl = len(self.layers)
+        for ci in range(nl - 1):
+            lp, impl, bottoms, tops = self.layers[ci]
+            if getattr(impl, "type_name", None) != "Convolution" \
+                    or not impl.bias_term or len(tops) != 1 \
+                    or any(self.loss_weights[lp.name]):
+                continue
+            top = tops[0]
+            rlp, rimpl, rbot, rtop = self.layers[ci + 1]
+            if getattr(rimpl, "type_name", None) != "ReLU" \
+                    or rbot != [top] or rtop != [top] \
+                    or any(self.loss_weights[rlp.name]):
+                continue
+            if rlp.has("relu_param") and rlp.relu_param.negative_slope:
+                continue
+            plan[ci] = (ci + 1, None)
+            if ci + 2 >= nl or len(self.blob_shapes[top]) != 4:
+                continue
+            llp, limpl, lbot, ltop = self.layers[ci + 2]
+            if getattr(limpl, "type_name", None) != "LRN" or limpl.within \
+                    or lbot != [top]:
+                continue
+            later = sum(b == top for lj in range(ci + 3, nl)
+                        for b in self.layers[lj][2])
+            if later == 0 and top not in self.output_blobs:
+                plan[ci] = (ci + 1, ci + 2)
+        self._epilogue_cache = plan
+        return plan
+
+    def _active_epilogue(self):
+        """The epilogue sites the SPARKNET_EPILOGUE policy enables for
+        this trace: off — none; auto — only the 3-op bias+ReLU+LRN
+        fusion, on TPU (plain bias+ReLU is already XLA's conv epilogue,
+        and a pallas boundary there costs an extra HBM pass — the
+        pallas-LRN lesson from PERF.md round-3); on — every site, any
+        backend (CPU runs the kernels in interpret mode: tests)."""
+        import os
+        mode = os.environ.get("SPARKNET_EPILOGUE", "auto").lower()
+        if mode == "off":
+            return {}
+        plan = self._epilogue_plan()
+        if mode == "on":
+            return plan
+        if jax.default_backend() != "tpu":
+            return {}
+        return {ci: v for ci, v in plan.items() if v[1] is not None}
+
     def _apply_range(self, params, state, new_state, blobs, lo, hi, batch,
-                     train, rng, fiss):
+                     train, rng, fiss, ep=None):
         """Run layers [lo, hi) over the mutable blob dict (the body the
-        remat segments replay)."""
+        remat segments replay). ``ep``: active epilogue-fusion sites;
+        a site engages only when its whole conv/ReLU(/LRN) window lies
+        inside [lo, hi), else the layers run unfused (correct either
+        way)."""
         from . import fission
+        skip = set()
         for li in range(lo, hi):
+            if li in skip:
+                continue
             lp, impl, bottoms, tops = self.layers[li]
             if getattr(impl, "is_feed", False):
                 for t in tops:
@@ -333,6 +434,28 @@ class CompiledNet:
             lparams = self.resolve_params(params, lp.name)
             bvals = [blobs[b] for b in bottoms]
             lrng = jax.random.fold_in(rng, li) if impl.needs_rng else None
+            fuse = ep.get(li) if ep else None
+            if fuse is not None and max(x for x in fuse
+                                        if x is not None) < hi:
+                from ..ops import pallas_epilogue as pe
+                ri, lrni = fuse
+                bvals = [fission.materialize(v) for v in bvals]
+                y = impl.apply_raw(lparams, bvals, train, lrng)
+                b = lparams[1]
+                skip.add(ri)
+                if lrni is None:
+                    # ReLU is the in-place rebind: the fused output IS
+                    # the conv/relu blob, bit-for-bit
+                    blobs[tops[0]] = pe.bias_relu(y, b)
+                else:
+                    lm = self.layers[lrni][1]
+                    blobs[self.layers[lrni][3][0]] = pe.bias_relu_lrn(
+                        y, b, lm.size, lm.alpha, lm.beta, lm.k)
+                    skip.add(lrni)
+                    # the relu'd pre-LRN blob is never materialized;
+                    # absent, never stale (plan proved no consumer)
+                    blobs.pop(tops[0], None)
+                continue
             tvals = fission.try_apply(lp, impl, lparams, bvals,
                                       train, lrng) if fiss else None
             if tvals is None:
@@ -346,6 +469,163 @@ class CompiledNet:
                     tvals = impl.apply(lparams, bvals, train, lrng)
             for t, v in zip(tops, tvals):
                 blobs[t] = v
+
+    def _scan_runs(self):
+        """Scan-over-layers sites, cached: maximal runs of >= 2
+        consecutive structurally identical "prefix/" layer groups (the
+        zoo's "block{i}/..." transformer convention), each chained
+        through a single boundary blob.
+
+        Two groups are identical when every corresponding layer matches
+        on type, name suffix, prefix-stripped bottoms/tops, top blob
+        shapes, and owned param shapes/dtypes — and is stateless,
+        rng-free, loss-free, feed-free, with no cross-layer param
+        sharing. Chaining requires group i's one external input to be
+        group i-1's one externally consumed top, read by nothing else.
+        Under those conditions the whole run executes as ONE traced
+        block body under lax.scan over stacked per-group params,
+        collapsing per-layer trace/dispatch/compile cost from O(depth)
+        to O(1) — the d512 LM row's dominant overhead (PERF.md).
+
+        Returns [{lo, hi, glen, n, entry, body_out, out}]: layer range,
+        group length/count, group-0's external input blob, group-0's
+        boundary top (the scan carry), and the LAST group's boundary
+        blob name (where the carry lands). Config fields that don't
+        change shapes (e.g. LayerNorm eps) are not compared; the zoo
+        emits blocks from one generator, so they cannot differ there."""
+        if self._scan_cache is not None:
+            return self._scan_cache
+        pgroups = []                       # (prefix, lo, hi)
+        prefix, start = None, 0
+        for li, (lp, _, _, _) in enumerate(self.layers):
+            p = lp.name.split("/")[0] if "/" in lp.name else None
+            if p != prefix:
+                if prefix is not None:
+                    pgroups.append((prefix, start, li))
+                prefix, start = p, li
+        if prefix is not None:
+            pgroups.append((prefix, start, len(self.layers)))
+        nl = len(self.layers)
+
+        def group_info(gi):
+            """(signature, entry, boundary) or None if ineligible."""
+            pfx, lo, hi = pgroups[gi]
+            produced, sig, externals = set(), [], set()
+            strip = len(pfx) + 1
+            for li in range(lo, hi):
+                lp, impl, bottoms, tops = self.layers[li]
+                if getattr(impl, "is_feed", False) or impl.has_state \
+                        or impl.needs_rng \
+                        or any(self.loss_weights[lp.name]):
+                    return None
+                if any(owner != lp.name
+                       for owner, _ in self.param_refs[lp.name]):
+                    return None
+                bsig = []
+                for b in bottoms:
+                    if b in produced:
+                        bsig.append(b[strip:] if b.startswith(pfx + "/")
+                                    else b)
+                    else:
+                        externals.add(b)
+                        bsig.append("\x00ENTRY")
+                pshapes = tuple(
+                    (self.param_meta[k][0],)
+                    for k in self.param_refs[lp.name])
+                sig.append((lp.type, lp.name[strip:], tuple(bsig),
+                            tuple(t[strip:] if t.startswith(pfx + "/")
+                                  else "\x00T:" + t for t in tops),
+                            tuple(tuple(self.blob_shapes[t]) for t in tops),
+                            pshapes))
+                produced.update(tops)
+            if len(externals) != 1:
+                return None
+            out = {t for li in range(lo, hi) for t in self.layers[li][3]
+                   if t in self.output_blobs
+                   or any(t in self.layers[lj][2] for lj in range(hi, nl))}
+            if len(out) != 1:
+                return None
+            return tuple(sig), next(iter(externals)), next(iter(out))
+
+        infos = [group_info(gi) for gi in range(len(pgroups))]
+
+        def chains(a, b):
+            """Group b continues group a: same structure, b's input is
+            a's boundary, and that blob is read by b ALONE."""
+            ia, ib = infos[a], infos[b]
+            if ia is None or ib is None or ia[0] != ib[0]:
+                return False
+            if pgroups[a][2] != pgroups[b][1]:     # must be adjacent
+                return False
+            if ib[1] != ia[2] or ia[2] in self.output_blobs:
+                return False
+            bhi = pgroups[b][2]
+            return not any(ia[2] in self.layers[lj][2]
+                           for lj in range(bhi, nl))
+
+        runs, gi = [], 0
+        while gi < len(pgroups):
+            gj = gi
+            while gj + 1 < len(pgroups) and chains(gj, gj + 1):
+                gj += 1
+            if gj > gi:
+                lo, hi = pgroups[gi][1], pgroups[gj][2]
+                runs.append({"lo": lo, "hi": hi,
+                             "glen": pgroups[gi][2] - pgroups[gi][1],
+                             "n": gj - gi + 1,
+                             "entry": infos[gi][1],
+                             "body_out": infos[gi][2],
+                             "out": infos[gj][2]})
+            gi = gj + 1
+        self._scan_cache = runs
+        return runs
+
+    def _scan_enabled(self):
+        """SPARKNET_SCAN / self.scan policy: off — unrolled (every blob
+        materialized, the extract_features-friendly default off-TPU);
+        auto — scan on TPU only (XLA:CPU pessimizes loop bodies, the
+        LocalSGD unroll precedent); on — scan everywhere (tests)."""
+        import os
+        mode = self.scan if self.scan is not None \
+            else os.environ.get("SPARKNET_SCAN", "auto").lower()
+        if mode == "on":
+            return True
+        if mode == "auto":
+            return jax.default_backend() == "tpu"
+        return False
+
+    def _apply_scan(self, run, params, blobs, train, pol):
+        """Execute one scan run: stack each group's params on a leading
+        scan axis and run group 0's traced body once under lax.scan.
+        Group-internal blobs are never materialized (absent, never
+        stale); only the final boundary blob lands in ``blobs``. The
+        remat policy composes by checkpointing the body — one block of
+        activations live at a time in the backward."""
+        from . import fission
+        lo, glen, n = run["lo"], run["glen"], run["n"]
+        g0 = self.layers[lo:lo + glen]
+        stacked = []
+        for j in range(glen):
+            names = [self.layers[lo + g * glen + j][0].name
+                     for g in range(n)]
+            stacked.append([jnp.stack([params[nm][i] for nm in names])
+                            for i in range(len(params.get(names[0], [])))])
+        entry, body_out = run["entry"], run["body_out"]
+
+        def body(x, ps):
+            sblobs = {entry: x}
+            for j, (lp, impl, bottoms, tops) in enumerate(g0):
+                tvals = impl.apply(ps[j], [sblobs[b] for b in bottoms],
+                                   train, None)
+                for t, v in zip(tops, tvals):
+                    sblobs[t] = v
+            return sblobs[body_out], None
+
+        if pol != "none":
+            body = _checkpointed(body, pol)
+        x0 = fission.materialize(blobs[entry])
+        xN, _ = jax.lax.scan(body, x0, stacked)
+        blobs[run["out"]] = xN
 
     def _segment_externals(self, lo, hi):
         """Blob names a [lo, hi) segment must surface: consumed by later
@@ -367,34 +647,69 @@ class CompiledNet:
     def apply(self, params, state, batch, train=None, rng=None):
         """Run the forward pass. Pure; jit/grad-safe.
 
-        With SPARKNET_REMAT=1 and train=True, runs of layers sharing a
-        "prefix/" name (the zoo's per-block convention) execute under
-        jax.checkpoint: the backward pass recomputes their internals
-        instead of saving every intermediate activation — the standard
-        TPU memory/FLOPs trade for deep transformers. Segment-INTERNAL
-        blobs are then absent from the returned dict (only segment
-        boundaries, loss tops and net outputs survive), which training
-        never reads; keep remat off for extract_features-style blob
-        inspection."""
+        Three trace-time policies compose here (each read once per
+        trace, so a long-lived jit never sees them change — toggles go
+        through Solver.set_remat/set_scan, which rebuild the jit):
+
+        * remat (--remat / SPARKNET_REMAT: none|dots|full) — with
+          train=True, runs of layers sharing a "prefix/" name (the
+          zoo's per-block convention) execute under jax.checkpoint with
+          the named policy: the backward recomputes their internals
+          instead of saving every intermediate activation. Segment-
+          INTERNAL blobs are then absent from the returned dict (only
+          segment boundaries, loss tops and net outputs survive).
+        * scan (SPARKNET_SCAN: auto|on|off) — structurally identical
+          block chains (_scan_runs) execute as one lax.scan over
+          stacked params: one traced body instead of depth copies.
+          Block-internal blobs are absent; remat checkpoints the body.
+        * epilogue (SPARKNET_EPILOGUE: auto|on|off) — conv bias+ReLU
+          (+LRN) tails run as one fused pallas pass (_active_epilogue).
+
+        Keep all three off for extract_features-style blob inspection."""
         if train is None:
             train = (self.phase == TRAIN)
         if rng is None:
             rng = jax.random.PRNGKey(0)
-        import os
         from . import fission
         fiss = fission.enabled()
-        remat = train and os.environ.get("SPARKNET_REMAT", "0") == "1"
-        groups = self._remat_groups() if remat else {}
+        pol = (self.remat if self.remat is not None else _env_remat()) \
+            if train else "none"
+        if pol not in REMAT_POLICIES:
+            raise ValueError(f"remat={pol!r}: want none|dots|full")
+        groups = self._remat_groups() if pol != "none" else {}
+        scans = {r["lo"]: r for r in self._scan_runs()} \
+            if self._scan_enabled() else {}
+        ep = self._active_epilogue()
         blobs = {}
         for n in self.net_inputs:
             blobs[n] = jnp.asarray(batch[n])
         new_state = dict(state)
         li = 0
         while li < len(self.layers):
+            run = scans.get(li)
+            if run is not None:
+                self._apply_scan(run, params, blobs, train, pol)
+                li = run["hi"]
+                continue
             hi = groups.get(li)
             if hi is None:
+                # a fusion site outside any remat segment dispatches its
+                # whole conv/ReLU(/LRN) window in one range so the fused
+                # branch engages; a window straddling a segment or scan
+                # run falls back to unfused (correct either way)
+                fuse = ep.get(li) if ep else None
+                if fuse is not None:
+                    end = max(x for x in fuse if x is not None) + 1
+                    if all(j not in groups and j not in scans
+                           for j in range(li + 1, end)):
+                        self._apply_range(params, state, new_state, blobs,
+                                          li, end, batch, train, rng,
+                                          fiss, ep=ep)
+                        li = end
+                        continue
                 self._apply_range(params, state, new_state, blobs,
-                                  li, li + 1, batch, train, rng, fiss)
+                                  li, li + 1, batch, train, rng, fiss,
+                                  ep=ep)
                 li += 1
                 continue
             # remat segment [li, hi): close over statics, checkpoint the
@@ -407,7 +722,6 @@ class CompiledNet:
                                  for j in range(lo, hi)
                                  if self.layers[j][1].has_state})
 
-            @jax.checkpoint
             def seg_fn(params, state, in_vals, rng, lo=lo, hi=hi,
                        in_names=in_names, out_names=out_names,
                        seg_states=seg_states):
@@ -415,12 +729,12 @@ class CompiledNet:
                           for n, v in zip(in_names, in_vals)}
                 sstate = dict(state)
                 self._apply_range(params, state, sstate, sblobs,
-                                  lo, hi, batch, train, rng, fiss)
+                                  lo, hi, batch, train, rng, fiss, ep=ep)
                 return ([fission.materialize(sblobs[n])
                          for n in out_names],
                         [sstate[n] for n in seg_states])
 
-            out_vals, out_states = seg_fn(
+            out_vals, out_states = _checkpointed(seg_fn, pol)(
                 params, state,
                 [fission.materialize(blobs[n]) for n in in_names], rng)
             # a blob produced before the segment and overwritten in-place

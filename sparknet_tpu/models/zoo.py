@@ -279,6 +279,15 @@ def transformer_lm(vocab_size=512, seq_len=256, batch_size=8, d_model=256,
 
     Blobs: "data" (B, S) int32 token ids, "label" (B, S) int32 next-token
     ids. Loss is mean cross-entropy per token (SoftmaxWithLoss axis=2).
+
+    Every "block{i}/" group is emitted by this one loop, so the blocks
+    are structurally isomorphic by construction and chain through a
+    single boundary blob — exactly what graph/compiler.py's
+    scan-over-layers detector (_scan_runs) requires to collapse the
+    stack into one lax.scan body (SPARKNET_SCAN / ``--scan``), and what
+    the per-block remat segments checkpoint (``--remat``). Renaming
+    blocks away from the shared prefix, sharing params across blocks,
+    or giving one block a different shape silently forfeits both.
     """
     d_ff = d_ff or 4 * d_model
     max_positions = max_positions or seq_len

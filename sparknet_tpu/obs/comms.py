@@ -110,6 +110,21 @@ class CommsMeter:
         return int(sum(c["bytes_per_round"] / c["steps_per_round"]
                        for c in self.collectives))
 
+    def overlapped_bytes_per_step(self):
+        """Per-step bytes of collectives the registering solver marked
+        ``overlappable=True`` — issued while compute that doesn't depend
+        on them still runs (the bucketed grad allreduce: every bucket
+        but the last-issued one hides under the backward tail)."""
+        return int(sum(c["bytes_per_round"] / c["steps_per_round"]
+                       for c in self.collectives
+                       if c.get("overlappable")))
+
+    def exposed_bytes_per_step(self):
+        """Per-step bytes structurally stuck on the critical path: the
+        whole-tree collectives plus the last-issued bucket."""
+        return (self.collective_bytes_per_step()
+                - self.overlapped_bytes_per_step())
+
     def tick(self, it, force=False):
         """Call once per step/round with the just-finished iteration."""
         self._nticks += 1
@@ -125,6 +140,16 @@ class CommsMeter:
                   collective_bytes_per_step=self.collective_bytes_per_step())
         if self.collectives:
             ev["collectives"] = self.collectives
+            over = self.overlapped_bytes_per_step()
+            if over:
+                total = self.collective_bytes_per_step()
+                ev["overlapped_bytes_per_step"] = over
+                ev["exposed_bytes_per_step"] = self.exposed_bytes_per_step()
+                # upper bound: realized overlap depends on backward being
+                # long enough to hide under — the trace, not this model,
+                # settles that. This is the structural ceiling.
+                ev["overlap_ceiling"] = round(over / total, 4) if total \
+                    else 0.0
         self.sink.log("comms", **ev)
         self.h2d_bytes = 0
         self._last_emit_it = it

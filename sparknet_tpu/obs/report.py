@@ -120,7 +120,9 @@ def aggregate(events):
              "collective_bytes_per_step":
                  last.get("collective_bytes_per_step"),
              "collectives": last.get("collectives", [])}
-        for k in ("strategy", "n_devices", "axes", "param_bytes"):
+        for k in ("strategy", "n_devices", "axes", "param_bytes",
+                  "overlapped_bytes_per_step", "exposed_bytes_per_step",
+                  "overlap_ceiling"):
             if k in last:
                 c[k] = last[k]
         rep["comms"] = c
@@ -453,7 +455,17 @@ def render(rep):
                  f"{_fmt_bytes(c.get('h2d_bytes_total'))}")
         L.append(f"  collective volume/step (per chip): "
                  f"{_fmt_bytes(c.get('collective_bytes_per_step'))}")
-        for col in c.get("collectives", []):
+        if _num(c.get("overlapped_bytes_per_step")):
+            L.append(f"  overlappable with backward: "
+                     f"{_fmt_bytes(c['overlapped_bytes_per_step'])}"
+                     f" ({100 * c.get('overlap_ceiling', 0):.1f}% ceiling)"
+                     f", exposed: "
+                     f"{_fmt_bytes(c.get('exposed_bytes_per_step'))}")
+        cols = c.get("collectives", [])
+        buckets = [col for col in cols if col.get("bucket") is not None]
+        for col in cols:
+            if col.get("bucket") is not None:
+                continue
             per = col.get("bytes_per_round", 0)
             tau = col.get("steps_per_round", 1)
             line = (f"    {col.get('kind'):<22} "
@@ -461,6 +473,21 @@ def render(rep):
             if col.get("paper_broadcast_collect_bytes"):
                 line += (" (paper broadcast+collect: "
                          f"{_fmt_bytes(col['paper_broadcast_collect_bytes'])})")
+            L.append(line)
+        if buckets:
+            tot = sum(col.get("bytes_per_round", 0) for col in buckets)
+            nover = sum(1 for col in buckets if col.get("overlappable"))
+            line = (f"    {buckets[0].get('kind'):<22} "
+                    f"x{len(buckets)} buckets, {_fmt_bytes(tot)}/round "
+                    f"total, {nover} overlappable + "
+                    f"{len(buckets) - nover} exposed")
+            paper = next((col["paper_broadcast_collect_bytes"]
+                          for col in buckets
+                          if col.get("paper_broadcast_collect_bytes")),
+                         None)
+            if paper:
+                line += (" (paper broadcast+collect: "
+                         f"{_fmt_bytes(paper)})")
             L.append(line)
 
     t = rep.get("train")

@@ -149,14 +149,14 @@ class Convolution(Layer):
             x, w, window_strides=(1, 1), padding="VALID",
             dimension_numbers=("NCHW", "OIHW", "NCHW"))
 
-    def apply(self, params, bottoms, train, rng):
+    def apply_raw(self, params, bottoms, train, rng):
+        """The convolution WITHOUT its bias add, NCHW out. The fused-
+        epilogue path (graph/compiler.py + ops/pallas_epilogue.py) calls
+        this and applies bias+ReLU(+LRN) in one pallas pass."""
         x = bottoms[0]
         w = params[0].astype(x.dtype)
         if self._s2d_eligible():
-            y = self._s2d_conv(x, w)
-            if self.bias_term:
-                y = y + params[1].astype(x.dtype)[None, :, None, None]
-            return [y]
+            return self._s2d_conv(x, w)
         layout = _conv_layout()
         nhwc = self.group > 1 if layout == "auto" else layout == "nhwc"
         if nhwc:
@@ -171,8 +171,12 @@ class Convolution(Layer):
         )
         if nhwc:
             y = y.transpose(0, 3, 1, 2)
+        return y
+
+    def apply(self, params, bottoms, train, rng):
+        y = self.apply_raw(params, bottoms, train, rng)
         if self.bias_term:
-            y = y + params[1].astype(x.dtype)[None, :, None, None]
+            y = y + params[1].astype(y.dtype)[None, :, None, None]
         return [y]
 
     def apply_fissioned(self, params, branches, train, rng):

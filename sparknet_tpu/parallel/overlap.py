@@ -1,0 +1,127 @@
+"""Bucketed gradient allreduce: overlap the DP consensus with backward.
+
+The single whole-tree pmean in DataParallelSolver's step is one giant
+collective whose every input is the LAST gradient backward produces
+(the first layer's), so XLA's latency-hiding scheduler cannot start any
+of it until backward fully drains: the entire 2(N-1)/N * B ring transfer
+is exposed on the critical path. Splitting the gradient tree into
+fixed-size buckets in REVERSE flatten order restores the dependency
+structure the scheduler needs: the first bucket holds the deepest
+layers' grads, which backward finishes first, so its allreduce issues
+while the remaining layers' backward is still running. Only the
+last-issued bucket — the stem/embedding grads — is structurally exposed.
+
+Numerics: masked_consensus / weighted_consensus (resilience/elastic.py)
+are elementwise tree_maps followed by pmean; concatenating leaves into
+flat per-dtype buffers and running THE SAME functions over the bucket
+list is bit-for-bit the unbucketed consensus per element (pmean is
+elementwise; concatenation changes neither values nor reduce order
+across the axis). tests/test_overlap.py pins that equality exactly.
+
+The stats consensus path (masked_consensus_stats) needs the per-LAYER
+tree for its divergence decomposition, so it stays unbucketed — a
+documented trade: `--metrics` runs measure gradient noise instead of
+maximizing overlap.
+
+Gates: SPARKNET_OVERLAP=on|off (default on — bit-for-bit safe),
+SPARKNET_BUCKET_MB (default 4; ~4MB amortizes ring latency without
+delaying the first issue, the bucket-size sweet spot most DDP
+implementations converged on).
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+
+DEFAULT_BUCKET_MB = 4
+
+
+def overlap_enabled():
+    v = os.environ.get("SPARKNET_OVERLAP", "on").strip().lower()
+    if v in ("on", "1", ""):
+        return True
+    if v in ("off", "0"):
+        return False
+    raise ValueError(
+        f"SPARKNET_OVERLAP={v!r}: expected on|off")
+
+
+def bucket_bytes():
+    mb = os.environ.get("SPARKNET_BUCKET_MB", "").strip()
+    mb = float(mb) if mb else float(DEFAULT_BUCKET_MB)
+    if mb <= 0:
+        raise ValueError(f"SPARKNET_BUCKET_MB={mb}: must be > 0")
+    return int(mb * (1 << 20))
+
+
+def plan_buckets(tree, max_bytes=None):
+    """Partition ``tree``'s leaves into contiguous per-dtype buckets of
+    at most ``max_bytes`` each, walking leaves in REVERSE flatten order
+    (flatten order is layer order, and backward produces the last
+    layers' grads first — so bucket 0 is ready earliest). A leaf larger
+    than ``max_bytes`` gets a bucket of its own; dtypes never mix inside
+    a bucket (concatenation must not upcast). Works on abstract values:
+    only shape/dtype are read, so the plan can be built under a trace."""
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    if max_bytes is None:
+        max_bytes = bucket_bytes()
+    buckets = []
+    cur, cur_bytes, cur_dtype = [], 0, None
+    for idx in reversed(range(len(leaves))):
+        leaf = leaves[idx]
+        dt = jnp.result_type(leaf)
+        size = 1
+        for d in leaf.shape:
+            size *= int(d)
+        nb = size * dt.itemsize
+        if cur and (dt != cur_dtype or cur_bytes + nb > max_bytes):
+            buckets.append(cur)
+            cur, cur_bytes = [], 0
+        cur.append((idx, tuple(leaf.shape), dt, size))
+        cur_dtype = dt
+        cur_bytes += nb
+    if cur:
+        buckets.append(cur)
+    return {"treedef": treedef, "n_leaves": len(leaves),
+            "buckets": buckets}
+
+
+def bucket_sizes(plan):
+    """Per-bucket payload bytes, in issue order — what _register_comms
+    feeds the ring model per bucket."""
+    return [sum(size * dt.itemsize for _, _, dt, size in b)
+            for b in plan["buckets"]]
+
+
+def to_buckets(plan, tree):
+    """Tree -> list of flat 1-D per-dtype buffers, in issue order."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    out = []
+    for b in plan["buckets"]:
+        flats = [leaves[idx].ravel() for idx, _, _, _ in b]
+        out.append(flats[0] if len(flats) == 1 else jnp.concatenate(flats))
+    return out
+
+
+def from_buckets(plan, buckets):
+    """Inverse of to_buckets: bucket list -> the original tree. ravel/
+    slice/reshape are layout no-ops to XLA, so the roundtrip adds no
+    copies beyond the concatenation itself."""
+    leaves = [None] * plan["n_leaves"]
+    for b, flat in zip(plan["buckets"], buckets):
+        off = 0
+        for idx, shape, _, size in b:
+            leaves[idx] = flat[off:off + size].reshape(shape)
+            off += size
+    return jax.tree_util.tree_unflatten(plan["treedef"], leaves)
+
+
+def bucketed_consensus(consensus_fn, grads, weight, axis):
+    """Run ``consensus_fn`` (masked_consensus or weighted_consensus —
+    both tree-generic) over the bucketed form of ``grads`` and restore
+    the tree. Returns the same (consensus, n) pair as the direct call,
+    bit-for-bit (see module docstring)."""
+    plan = plan_buckets(grads)
+    out, n = consensus_fn(to_buckets(plan, grads), weight, axis)
+    return from_buckets(plan, out), n

@@ -150,7 +150,7 @@ def masked_scalar_mean(x, valid, axis):
                          axis) * scale
 
 
-def masked_consensus_stats(tree, valid, axis):
+def masked_consensus_stats(tree, valid, axis, consensus=None):
     """masked_consensus + the divergence aux of
     obs/divergence.consensus_stats, dead workers excluded from the
     drift statistics (their distance to consensus is garbage). The aux
@@ -158,11 +158,17 @@ def masked_consensus_stats(tree, valid, axis):
 
       valid    (N,) all_gather of each worker's effective validity
       n_live   live count the average renormalized over
+
+    ``consensus``: optional precomputed (consensus, n_live) pair — the
+    DP solver passes the bucketed collective's result (parallel/
+    overlap.py, bit-for-bit the direct call) so overlap and divergence
+    metering compose instead of excluding each other.
     """
     import jax
     import jax.numpy as jnp
     from ..obs.divergence import tree_sq_dist
-    consensus, n_live = masked_consensus(tree, valid, axis)
+    consensus, n_live = masked_consensus(tree, valid, axis) \
+        if consensus is None else consensus
     per_layer, local_sq = tree_sq_dist(tree, consensus)
     keep = valid > 0
     local_sq = jnp.where(keep, local_sq, jnp.float32(0))
@@ -239,18 +245,21 @@ def weighted_consensus(tree, weight, axis):
     return jax.tree_util.tree_map(one, tree), wsum
 
 
-def weighted_consensus_stats(tree, valid, weight, axis):
+def weighted_consensus_stats(tree, valid, weight, axis, consensus=None):
     """weighted_consensus + the divergence aux of masked_consensus_stats.
     ``valid`` is the membership bit (alive AND device-finite — what the
     ElasticPolicy consumes for eviction streaks; a parked-but-healthy
     worker stays valid), ``weight`` the staleness-discounted consensus
     weight (valid * staleness_discount(lag)). Drift statistics cover the
     INCLUDED workers (weight > 0); the aux additionally gathers the
-    weight vector so the host can attribute drift to staleness."""
+    weight vector so the host can attribute drift to staleness.
+    ``consensus``: optional precomputed (consensus, weight_sum) pair —
+    same contract as masked_consensus_stats."""
     import jax
     import jax.numpy as jnp
     from ..obs.divergence import tree_sq_dist
-    consensus, wsum = weighted_consensus(tree, weight, axis)
+    consensus, wsum = weighted_consensus(tree, weight, axis) \
+        if consensus is None else consensus
     included = (jnp.asarray(weight, jnp.float32) > 0)
     inc_f32 = included.astype(jnp.float32)
     per_layer, local_sq = tree_sq_dist(tree, consensus)

@@ -215,6 +215,40 @@ class Solver:
         self.test_input_transform = test_fn
         self._raw_feed_shapes = dict(raw_overrides) if raw_overrides else None
 
+    def _set_net_knob(self, attr, value):
+        """Set a trace-time perf knob on every CompiledNet this solver
+        owns and DROP the compiled steps. The policy is read once per
+        trace (graph/compiler.py), so flipping it under a live jit would
+        silently keep serving the old trace; rebuilding gives the new
+        policy a FRESH executable whose cache starts empty — a
+        mid-process toggle costs exactly one recompile and cannot leak
+        stale cache entries (tests/test_remat.py asserts both)."""
+        for name in ("net", "test_net", "local_net", "local_test_net"):
+            n = getattr(self, name, None)
+            if n is not None:
+                setattr(n, attr, value)
+        self._jit_train = None
+        self._jit_eval = None
+        if hasattr(self, "_jit_round"):
+            self._jit_round = None
+
+    def set_remat(self, policy):
+        """Set the remat policy (the --remat CLI knob): "none", "dots"
+        (save matmul outputs, recompute elementwise tails), or "full".
+        Overrides the SPARKNET_REMAT env-var fallback."""
+        from ..graph.compiler import REMAT_POLICIES
+        if policy not in REMAT_POLICIES:
+            raise ValueError(
+                f"remat policy {policy!r}: want one of {REMAT_POLICIES}")
+        self._set_net_knob("remat", policy)
+
+    def set_scan(self, mode):
+        """Set the scan-over-layers mode: "auto" (TPU only), "on", or
+        "off". Overrides the SPARKNET_SCAN env-var fallback."""
+        if mode not in ("auto", "on", "off"):
+            raise ValueError(f"scan mode {mode!r}: want auto|on|off")
+        self._set_net_knob("scan", mode)
+
     def _wrapped_loss(self, net):
         """net.loss_fn with the device-side input transform folded in."""
         tf = self.input_transform

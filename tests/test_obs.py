@@ -265,17 +265,29 @@ class TestSolverObs:
         data = toy_batches()
         for _ in range(2):
             s.train_step(next(data))
-        expected = ring_allreduce_bytes(
-            tree_bytes(s.params) + tree_bytes(s.state), 2)
+        gb, sb = tree_bytes(s.params), tree_bytes(s.state)
+        expected = ring_allreduce_bytes(gb + sb, 2)
         s.close()
         evs = events_of(buf)
         comms = [e for e in evs if e["event"] == "comms"]
         assert comms, "no comms events from DP solver"
-        col = comms[0]["collectives"][0]
-        assert col["kind"] == "allreduce_grads"
-        assert col["bytes_per_round"] == expected
-        assert col["paper_broadcast_collect_bytes"] == \
-            broadcast_collect_bytes(tree_bytes(s.params), 2)
+        # bucketed overlap is the default: grads register per bucket in
+        # issue order, state separately — total bytes unchanged (the
+        # ring model is exactly linear at n=2)
+        cols = comms[0]["collectives"]
+        grads = [c for c in cols if c["kind"] == "allreduce_grads_bucket"]
+        state = [c for c in cols if c["kind"] == "allreduce_state"]
+        # the stateless toy MLP registers no zero-byte state collective
+        assert grads and len(state) == (1 if sb else 0)
+        assert sum(c["bytes_per_round"] for c in grads) == \
+            ring_allreduce_bytes(gb, 2)
+        assert [c["bucket"] for c in grads] == list(range(len(grads)))
+        assert not grads[-1]["overlappable"]
+        # the paper comparison rides the (always-registered) grad volume
+        assert grads[-1]["paper_broadcast_collect_bytes"] == \
+            broadcast_collect_bytes(gb, 2)
+        if state:
+            assert state[0]["bytes_per_round"] == ring_allreduce_bytes(sb, 2)
         assert comms[0]["axes"] == {"data": 2}
         assert comms[0]["collective_bytes_per_step"] == expected
 

@@ -1,0 +1,174 @@
+"""Bucketed overlapped gradient allreduce (parallel/overlap.py): the
+bucket plan/roundtrip is exact, the bucketed consensus is bit-for-bit
+the whole-tree consensus through a real DP solver, and the comms meter
+decomposes overlappable vs exposed collective bytes."""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from sparknet_tpu.models import zoo
+from sparknet_tpu.parallel import DataParallelSolver
+from sparknet_tpu.parallel.overlap import (
+    bucket_sizes, from_buckets, overlap_enabled, plan_buckets, to_buckets)
+from sparknet_tpu.proto import Message
+from sparknet_tpu.data.synthetic import class_gaussian_images
+
+
+def _tree(seed=0):
+    rs = np.random.RandomState(seed)
+    return {
+        "a": [jnp.asarray(rs.randn(33, 7), jnp.float32),
+              jnp.asarray(rs.randn(7), jnp.float32)],
+        "b": [jnp.asarray(rs.randn(1024, 17), jnp.float32)],
+        "c": [jnp.asarray(rs.randn(5), jnp.bfloat16)],
+    }
+
+
+class TestPlan:
+    def test_reverse_order_and_dtype_separation(self):
+        plan = plan_buckets(_tree(), max_bytes=1 << 30)
+        # bucket 0 starts from the LAST leaf (deepest layers' grads are
+        # ready first in backward); the bf16 leaf never shares a bucket
+        # with f32 neighbors
+        first = plan["buckets"][0]
+        assert first[0][0] == 3 and len(first) == 1
+        for b in plan["buckets"]:
+            assert len({dt for _, _, dt, _ in b}) == 1
+
+    def test_size_cap_and_oversize_leaf(self):
+        plan = plan_buckets(_tree(), max_bytes=8192)
+        sizes = bucket_sizes(plan)
+        big = 1024 * 17 * 4
+        # the oversize leaf gets its own bucket; every other bucket
+        # respects the cap
+        assert big in sizes
+        assert all(s <= 8192 for s in sizes if s != big)
+        total = sum(sz * dt.itemsize
+                    for leaf in jax.tree_util.tree_leaves(_tree())
+                    for sz, dt in [(leaf.size, leaf.dtype)])
+        assert sum(sizes) == total
+
+    def test_roundtrip_bitexact(self):
+        tree = _tree()
+        plan = plan_buckets(tree, max_bytes=4096)
+        back = from_buckets(plan, to_buckets(plan, tree))
+        flat_a = jax.tree_util.tree_leaves(tree)
+        flat_b = jax.tree_util.tree_leaves(back)
+        assert jax.tree_util.tree_structure(tree) \
+            == jax.tree_util.tree_structure(back)
+        for a, b in zip(flat_a, flat_b):
+            assert a.shape == b.shape and a.dtype == b.dtype
+            assert bool(jnp.all(a == b))
+
+    def test_env_gates(self, monkeypatch):
+        monkeypatch.setenv("SPARKNET_OVERLAP", "off")
+        assert not overlap_enabled()
+        monkeypatch.setenv("SPARKNET_OVERLAP", "on")
+        assert overlap_enabled()
+        monkeypatch.delenv("SPARKNET_OVERLAP", raising=False)
+        assert overlap_enabled()          # bit-for-bit safe -> default on
+        monkeypatch.setenv("SPARKNET_OVERLAP", "maybe")
+        with pytest.raises(ValueError):
+            overlap_enabled()
+
+
+class TestBitForBit:
+    def test_dp_training_identical_on_off(self, monkeypatch):
+        """Two DP runs — bucketed vs whole-tree consensus — must end
+        with BITWISE identical params: concatenation changes neither the
+        per-element math nor the cross-worker reduce order."""
+        net = zoo.lenet(batch_size=16)
+        imgs, labels = class_gaussian_images(
+            32, shape=(1, 28, 28), num_classes=10, seed=0)
+        imgs = imgs.reshape(2, 16, 1, 28, 28)
+        labels = labels.reshape(2, 16)
+
+        def run(mode):
+            monkeypatch.setenv("SPARKNET_OVERLAP", mode)
+            # tiny cap -> several buckets even at lenet size
+            monkeypatch.setenv("SPARKNET_BUCKET_MB", "0.05")
+            sp = Message("SolverParameter", base_lr=0.01,
+                         lr_policy="fixed", momentum=0.9,
+                         weight_decay=0.0, display=0, random_seed=7)
+            dp = DataParallelSolver(sp, net_param=net)
+            for i in range(2):
+                dp.train_step({"data": imgs[i], "label": labels[i]})
+            return dp.params
+
+    # sanity: the tiny cap really exercises multi-bucket consensus
+        monkeypatch.setenv("SPARKNET_BUCKET_MB", "0.05")
+        p_off = run("off")
+        assert len(plan_buckets(p_off)["buckets"]) > 1
+        p_on = run("on")
+        for a, b in zip(jax.tree_util.tree_leaves(p_off),
+                        jax.tree_util.tree_leaves(p_on)):
+            assert bool(jnp.all(a == b))
+
+
+class _Sink:
+    def __init__(self):
+        self.events = []
+
+    def log(self, event, **kw):
+        self.events.append(dict(kw, event=event))
+
+
+class TestCommsDecomposition:
+    def test_meter_overlap_fields(self):
+        from sparknet_tpu.obs.comms import CommsMeter
+        sink = _Sink()
+        cm = CommsMeter(sink, emit_every=1)
+        for bi, nb in enumerate([1000, 1000, 500]):
+            cm.register("allreduce_grads_bucket", nb, axis="data",
+                        bucket=bi, overlappable=bi < 2)
+        cm.register("allreduce_state", 200, axis="data")
+        cm.tick(0, force=True)
+        ev = sink.events[-1]
+        assert ev["collective_bytes_per_step"] == 2700
+        assert ev["overlapped_bytes_per_step"] == 2000
+        assert ev["exposed_bytes_per_step"] == 700
+        assert ev["overlap_ceiling"] == pytest.approx(2000 / 2700,
+                                                      abs=1e-4)
+
+    def test_dp_solver_registers_buckets(self, monkeypatch):
+        """With metrics on, the DP solver's comms registration carries
+        the per-bucket rows, the last-issued one exposed."""
+        monkeypatch.setenv("SPARKNET_OVERLAP", "on")
+        monkeypatch.setenv("SPARKNET_BUCKET_MB", "0.05")
+        from sparknet_tpu.obs.comms import CommsMeter
+        sp = Message("SolverParameter", base_lr=0.01, lr_policy="fixed",
+                     momentum=0.9, weight_decay=0.0, display=0,
+                     random_seed=7)
+        dp = DataParallelSolver(sp, net_param=zoo.lenet(batch_size=16))
+        sink = _Sink()
+        cm = CommsMeter(sink, emit_every=1)
+        dp._register_comms(cm)
+        buckets = [c for c in cm.collectives
+                   if c["kind"] == "allreduce_grads_bucket"]
+        assert len(buckets) > 1
+        assert [c["bucket"] for c in buckets] \
+            == list(range(len(buckets)))
+        assert all(c["overlappable"] for c in buckets[:-1])
+        assert not buckets[-1]["overlappable"]
+        assert cm.exposed_bytes_per_step() > 0
+
+    def test_report_renders_decomposition(self, tmp_path):
+        from sparknet_tpu.obs import report
+        ev = {"event": "comms", "iter": 0, "steps": 1, "h2d_bytes": 0,
+              "h2d_bytes_total": 0, "collective_bytes_per_step": 2700,
+              "overlapped_bytes_per_step": 2000,
+              "exposed_bytes_per_step": 700, "overlap_ceiling": 0.7407,
+              "collectives": [
+                  {"kind": "allreduce_grads_bucket", "bytes_per_round":
+                   1000, "steps_per_round": 1, "bucket": 0,
+                   "overlappable": True},
+                  {"kind": "allreduce_grads_bucket", "bytes_per_round":
+                   1700, "steps_per_round": 1, "bucket": 1,
+                   "overlappable": False}]}
+        rep = report.aggregate([ev])
+        assert rep["comms"]["overlapped_bytes_per_step"] == 2000
+        text = report.render(rep)
+        assert "overlappable with backward" in text
+        assert "x2 buckets" in text

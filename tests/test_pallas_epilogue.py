@@ -1,0 +1,233 @@
+"""Fused conv-epilogue kernels (ops/pallas_epilogue.py) == the XLA
+composition they replace, forward and gradient, plus the compiler's
+fusion-site selection and the end-to-end SPARKNET_EPILOGUE gate.
+
+Kernels run in pallas interpreter mode on CPU — the same kernels the TPU
+compiles natively. The LRN reference is the stock ops/lrn.py XLA path,
+itself forward-checked against the Caffe formula in test_layers.py.
+"""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from sparknet_tpu.ops.pallas_epilogue import bias_relu, bias_relu_lrn
+from sparknet_tpu.graph.compiler import CompiledNet, TRAIN
+from sparknet_tpu.models.dsl import (
+    RDDLayer, ConvolutionLayer, ReLULayer, LRNLayer, PoolingLayer,
+    InnerProductLayer, SoftmaxWithLoss, NetParam)
+from tests.test_layers import make_layer
+
+RNG = np.random.RandomState(11)
+
+SHAPES = [
+    pytest.param((2, 96, 9, 11), id="caffenet-conv-ish"),
+    pytest.param((1, 64, 8, 8), id="pow2"),
+    pytest.param((2, 32, 6, 130), id="wide-spatial-multi-block"),
+]
+
+
+def _ref_bias_relu(x, b):
+    return jnp.maximum(x + b.astype(x.dtype)[None, :, None, None], 0)
+
+
+def _ref_lrn(shape, size, alpha, beta, k):
+    layer, _ = make_layer(
+        "LRN", [shape],
+        lrn_param=dict(local_size=size, alpha=alpha, beta=beta, k=k))
+
+    def apply(v):
+        return layer.apply([], [v], False, None)[0]
+
+    return apply
+
+
+@pytest.mark.parametrize("shape", SHAPES)
+def test_bias_relu_forward(shape):
+    x = jnp.asarray(RNG.randn(*shape), jnp.float32)
+    b = jnp.asarray(RNG.randn(shape[1]), jnp.float32)
+    got = bias_relu(x, b)
+    ref = _ref_bias_relu(x, b)
+    assert got.shape == ref.shape and got.dtype == ref.dtype
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("shape", SHAPES)
+def test_bias_relu_gradient(shape):
+    x = jnp.asarray(RNG.randn(*shape), jnp.float32)
+    b = jnp.asarray(RNG.randn(shape[1]), jnp.float32)
+    w = jnp.cos(jnp.arange(int(np.prod(shape)), dtype=jnp.float32)
+                ).reshape(shape)
+
+    def loss(fn, xv, bv):
+        return (fn(xv, bv) * w).sum()
+
+    gx, gb = jax.grad(lambda xv, bv: loss(bias_relu, xv, bv),
+                      argnums=(0, 1))(x, b)
+    rx, rb = jax.grad(lambda xv, bv: loss(_ref_bias_relu, xv, bv),
+                      argnums=(0, 1))(x, b)
+    np.testing.assert_allclose(np.asarray(gx), np.asarray(rx),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(gb), np.asarray(rb),
+                               rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("shape", SHAPES)
+def test_bias_relu_lrn_forward(shape):
+    size, alpha, beta, k = 5, 1e-4, 0.75, 1.0
+    x = jnp.asarray(RNG.randn(*shape), jnp.float32)
+    b = jnp.asarray(RNG.randn(shape[1]), jnp.float32)
+    lrn = _ref_lrn(shape, size, alpha, beta, k)
+    got = bias_relu_lrn(x, b, size, alpha, beta, k)
+    ref = lrn(_ref_bias_relu(x, b))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_bias_relu_lrn_gradient():
+    shape, size, alpha, beta, k = (2, 32, 6, 10), 5, 1e-3, 0.75, 2.0
+    x = jnp.asarray(RNG.randn(*shape), jnp.float32)
+    b = jnp.asarray(RNG.randn(shape[1]), jnp.float32)
+    lrn = _ref_lrn(shape, size, alpha, beta, k)
+    w = jnp.sin(jnp.arange(int(np.prod(shape)), dtype=jnp.float32)
+                ).reshape(shape)
+
+    def fused(xv, bv):
+        return (bias_relu_lrn(xv, bv, size, alpha, beta, k) * w).sum()
+
+    def ref(xv, bv):
+        return (lrn(_ref_bias_relu(xv, bv)) * w).sum()
+
+    gx, gb = jax.grad(fused, argnums=(0, 1))(x, b)
+    rx, rb = jax.grad(ref, argnums=(0, 1))(x, b)
+    np.testing.assert_allclose(np.asarray(gx), np.asarray(rx),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(gb), np.asarray(rb),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_bf16_activation_dtype_roundtrip():
+    shape = (1, 32, 4, 36)
+    x = jnp.asarray(RNG.randn(*shape), jnp.bfloat16)
+    b = jnp.asarray(RNG.randn(shape[1]), jnp.float32)
+    got = bias_relu(x, b)
+    ref = _ref_bias_relu(x, b)
+    assert got.dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(got, np.float32),
+                               np.asarray(ref, np.float32),
+                               rtol=2e-2, atol=2e-2)
+    got3 = bias_relu_lrn(x, b, 5, 1e-4, 0.75, 1.0)
+    assert got3.dtype == jnp.bfloat16
+
+
+# -- compiler selection + end-to-end gate -----------------------------------
+
+def _conv(name, bottom, n, k, pad=None, bias=True):
+    lp = ConvolutionLayer(name, [bottom], (k, k), n,
+                          pad=(pad, pad) if pad else None,
+                          weight_filler=dict(type="gaussian", std=0.05),
+                          bias_filler=dict(type="constant", value=0.1))
+    if not bias:
+        lp.convolution_param.bias_term = False
+    return lp
+
+
+def _epilogue_net(batch=2):
+    """conv1+relu1+norm1 is a 3-op site; conv2+relu2 a 2-op site."""
+    return NetParam(
+        "eptest",
+        RDDLayer("data", [batch, 8, 12, 12]),
+        RDDLayer("label", [batch]),
+        _conv("conv1", "data", 16, 3, pad=1),
+        ReLULayer("relu1", ["conv1"], tops=["conv1"]),
+        LRNLayer("norm1", ["conv1"], local_size=5, alpha=1e-4, beta=0.75),
+        _conv("conv2", "norm1", 12, 3, pad=1),
+        ReLULayer("relu2", ["conv2"], tops=["conv2"]),
+        PoolingLayer("gap", ["conv2"], "AVE", (12, 12), (1, 1)),
+        InnerProductLayer("fc", ["gap"], 5,
+                          weight_filler=dict(type="gaussian", std=0.1)),
+        SoftmaxWithLoss("loss", ["fc", "label"]),
+    )
+
+
+def test_fusion_site_detection():
+    net = CompiledNet(_epilogue_net(), TRAIN)
+    plan = net._epilogue_plan()
+    by_name = {net.layers[ci][0].name: (net.layers[ri][0].name,
+                                        net.layers[li][0].name
+                                        if li is not None else None)
+               for ci, (ri, li) in plan.items()}
+    assert by_name == {"conv1": ("relu1", "norm1"),
+                       "conv2": ("relu2", None)}
+
+
+def _leaky(lp, slope=0.1):
+    from sparknet_tpu.proto import Message
+    lp.relu_param = Message("ReLUParameter", negative_slope=slope)
+    return lp
+
+
+def test_no_fusion_without_bias_or_with_leaky_relu():
+    net = NetParam(
+        "nofuse",
+        RDDLayer("data", [2, 4, 8, 8]),
+        RDDLayer("label", [2]),
+        _conv("conv1", "data", 8, 3, pad=1, bias=False),   # no bias term
+        ReLULayer("relu1", ["conv1"], tops=["conv1"]),
+        _conv("conv2", "conv1", 8, 3, pad=1),
+        _leaky(ReLULayer("relu2", ["conv2"], tops=["conv2"])),
+        PoolingLayer("gap", ["conv2"], "AVE", (8, 8), (1, 1)),
+        InnerProductLayer("fc", ["gap"], 3,
+                          weight_filler=dict(type="gaussian", std=0.1)),
+        SoftmaxWithLoss("loss", ["fc", "label"]),
+    )
+    assert CompiledNet(net, TRAIN)._epilogue_plan() == {}
+
+
+def test_auto_gate_is_off_on_cpu(monkeypatch):
+    """auto (the default) only fuses on TPU — off-TPU the pallas call
+    would run interpreted in the hot path."""
+    monkeypatch.delenv("SPARKNET_EPILOGUE", raising=False)
+    net = CompiledNet(_epilogue_net(), TRAIN)
+    if jax.default_backend() != "tpu":
+        assert net._active_epilogue() == {}
+    monkeypatch.setenv("SPARKNET_EPILOGUE", "on")
+    assert set(net._active_epilogue()) == set(net._epilogue_plan())
+
+
+def test_end_to_end_loss_and_grads_match(monkeypatch):
+    net = CompiledNet(_epilogue_net(), TRAIN)
+    params, state = net.init(jax.random.PRNGKey(0))
+    rs = np.random.RandomState(3)
+    batch = {"data": jnp.asarray(rs.randn(2, 8, 12, 12), jnp.float32),
+             "label": jnp.asarray(rs.randint(0, 5, (2,)), jnp.int32)}
+
+    def run(mode):
+        monkeypatch.setenv("SPARKNET_EPILOGUE", mode)
+        return jax.value_and_grad(
+            lambda p: net.loss_fn(p, state, batch)[0])(params)
+
+    l_off, g_off = run("off")
+    l_on, g_on = run("on")
+    np.testing.assert_allclose(float(l_on), float(l_off), rtol=1e-6)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5),
+        g_on, g_off)
+
+
+def test_fused_blobs_absent_never_stale(monkeypatch):
+    """The 3-op fusion never materializes the pre-LRN activation: with
+    no later consumer the blob must be ABSENT from the returned dict
+    (same discipline as remat segments), and the LRN output present."""
+    net = CompiledNet(_epilogue_net(), TRAIN)
+    params, state = net.init(jax.random.PRNGKey(0))
+    rs = np.random.RandomState(3)
+    batch = {"data": rs.randn(2, 8, 12, 12).astype(np.float32),
+             "label": rs.randint(0, 5, (2,))}
+    monkeypatch.setenv("SPARKNET_EPILOGUE", "on")
+    blobs, _ = net.apply(params, state, batch, train=True)
+    assert "norm1" in blobs
+    assert "conv1" not in blobs

@@ -1,0 +1,159 @@
+"""Scan-over-layers (SPARKNET_SCAN / CompiledNet.scan): the lax.scan
+over stacked per-block params must be numerically equivalent to the
+unrolled stack — loss and gradients — and must compose with remat.
+
+Also pins the solver-level knob contract (Solver.set_remat/set_scan):
+toggling mid-process drops the jit and costs EXACTLY one fresh compile,
+never a stale cache entry serving the old policy.
+"""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from sparknet_tpu.proto import Message
+from sparknet_tpu.models import zoo
+from sparknet_tpu.graph.compiler import CompiledNet, TRAIN
+from sparknet_tpu.solver.solver import Solver
+
+
+def _lm_net(layers=3):
+    return zoo.transformer_lm(vocab_size=64, seq_len=32, batch_size=2,
+                              d_model=32, num_layers=layers, num_heads=4,
+                              flash=False)
+
+
+def _batch(seed=0):
+    rs = np.random.RandomState(seed)
+    toks = rs.randint(0, 64, (2, 33))
+    return {"data": toks[:, :-1], "label": toks[:, 1:]}
+
+
+def test_run_detection_on_lm_stack():
+    net = CompiledNet(_lm_net(3), TRAIN)
+    runs = net._scan_runs()
+    assert len(runs) == 1
+    r = runs[0]
+    assert r["n"] == 3 and r["entry"] == "embed"
+    assert r["out"].endswith("/res2")
+    names = [net.layers[i][0].name for i in range(r["lo"], r["hi"])]
+    assert all(n.startswith("block") for n in names)
+
+
+def test_single_block_forms_no_run():
+    assert CompiledNet(_lm_net(1), TRAIN)._scan_runs() == []
+
+
+def test_scan_loss_and_grads_match_unrolled():
+    net = CompiledNet(_lm_net(3), TRAIN)
+    params, state = net.init(jax.random.PRNGKey(0))
+    batch = _batch()
+
+    def run(mode):
+        net.scan = mode
+        return jax.value_and_grad(
+            lambda p: net.loss_fn(p, state, batch)[0])(params)
+
+    l_off, g_off = run("off")
+    l_on, g_on = run("on")
+    np.testing.assert_allclose(float(l_on), float(l_off), rtol=1e-6)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6),
+        g_on, g_off)
+
+
+@pytest.mark.parametrize("pol", ["dots", "full"])
+def test_scan_composes_with_remat(pol):
+    net = CompiledNet(_lm_net(3), TRAIN)
+    params, state = net.init(jax.random.PRNGKey(0))
+    batch = _batch()
+    net.scan = "off"
+    net.remat = "none"
+    l_ref, g_ref = jax.value_and_grad(
+        lambda p: net.loss_fn(p, state, batch)[0])(params)
+    net.scan = "on"
+    net.remat = pol
+    l_sc, g_sc = jax.value_and_grad(
+        lambda p: net.loss_fn(p, state, batch)[0])(params)
+    np.testing.assert_allclose(float(l_sc), float(l_ref), rtol=1e-6)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6),
+        g_sc, g_ref)
+
+
+def test_scan_internal_blobs_absent_boundary_present():
+    """Scanned blocks follow the remat-segment blob discipline: internal
+    per-layer activations are ABSENT from the returned dict (only the
+    run's boundary output exists — one stacked carry lives on device,
+    which is the memory win), never stale."""
+    net = CompiledNet(_lm_net(3), TRAIN)
+    params, state = net.init(jax.random.PRNGKey(0))
+    batch = _batch()
+    net.scan = "off"
+    blobs_off, _ = net.apply(params, state, batch, train=True)
+    net.scan = "on"
+    blobs_on, _ = net.apply(params, state, batch, train=True)
+    run = net._scan_runs()[0]
+    assert run["out"] in blobs_on
+    assert "block0/attn" in blobs_off
+    assert not any(k.startswith("block0/") or k.startswith("block1/")
+                   for k in blobs_on)
+
+
+def test_auto_gate_is_off_on_cpu(monkeypatch):
+    monkeypatch.delenv("SPARKNET_SCAN", raising=False)
+    net = CompiledNet(_lm_net(3), TRAIN)
+    if jax.default_backend() != "tpu":
+        assert not net._scan_enabled()
+    monkeypatch.setenv("SPARKNET_SCAN", "on")
+    assert net._scan_enabled()
+
+
+# -- solver knob contract (the --remat / --scan CLI flags ride on this) -----
+
+def _solver():
+    sp = Message("SolverParameter", base_lr=0.05, lr_policy="fixed",
+                 momentum=0.9, display=0, random_seed=0)
+    return Solver(sp, net_param=_lm_net(2))
+
+
+def test_set_remat_one_fresh_compile_no_stale_entries(monkeypatch):
+    monkeypatch.delenv("SPARKNET_REMAT", raising=False)
+    s = _solver()
+    s.train_step(_batch())
+    jit_old = s._jit_train
+    assert jit_old._cache_size() == 1
+    # env flips AFTER tracing are inert: the policy is baked at trace
+    # time, so no recompile and no second entry appears
+    monkeypatch.setenv("SPARKNET_REMAT", "full")
+    s.train_step(_batch(1))
+    assert s._jit_train is jit_old and jit_old._cache_size() == 1
+    monkeypatch.delenv("SPARKNET_REMAT", raising=False)
+    # the real toggle goes through set_remat: the jit is DROPPED, the
+    # new one traces once under the new policy — 1 entry, none stale
+    s.set_remat("dots")
+    assert s._jit_train is None
+    s.train_step(_batch(2))
+    assert s._jit_train is not jit_old
+    assert s._jit_train._cache_size() == 1
+    assert s.net.remat == "dots"
+
+
+def test_set_remat_and_scan_validate():
+    s = _solver()
+    with pytest.raises(ValueError):
+        s.set_remat("bogus")
+    with pytest.raises(ValueError):
+        s.set_scan("sometimes")
+
+
+def test_set_scan_matches_unrolled_training():
+    def run(mode):
+        s = _solver()
+        s.set_scan(mode)
+        return [float(s.train_step(_batch(i))) for i in range(3)]
+
+    np.testing.assert_allclose(run("on"), run("off"), rtol=1e-5)
