@@ -26,6 +26,12 @@ echo "multihost smoke OK"
 bash scripts/smoke.sh async || exit 1
 echo "async smoke OK"
 
+# serving tier, end to end: serve a snapshot, bench it across a live
+# hot reload with zero rejects/errors, drain on SIGTERM with exit 0,
+# and render the serving section (scripts/smoke.sh stage i)
+bash scripts/smoke.sh serve || exit 1
+echo "serve smoke OK"
+
 set -o pipefail
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu \

@@ -27,8 +27,16 @@
 #       exceeds the gap), with the straggler parked+readmitted and the
 #       staleness section rendered by `sparknet report`.
 #
-# Usage: smoke.sh [all|multihost|async]  — `multihost`/`async` run only
-# that stage (the fast CI wiring; scripts/ci.sh invokes both).
+# Serving tier (ISSUE 11):
+#   (i) `sparknet serve` over a trained snapshot answers a closed-loop
+#       `serve-bench` with zero rejects/errors and a sane p99,
+#       hot-reloads a newer snapshot mid-load without dropping a
+#       request, drains on SIGTERM with exit 0, and `sparknet report`
+#       renders the serving section from the same metrics stream.
+#
+# Usage: smoke.sh [all|multihost|async|serve]  — `multihost`/`async`/
+# `serve` run only that stage (the fast CI wiring; scripts/ci.sh
+# invokes them individually).
 set -euo pipefail
 cd "$(dirname "$0")/.."
 export JAX_PLATFORMS=cpu
@@ -154,6 +162,159 @@ EOF
          "sync ${sync_s}s)"
 }
 
+# --------------------------------------------------- serving tier ----
+# Train a tiny MLP to a snapshot, serve it, and exercise the full
+# supervisor contract: bench under load (zero rejects at nominal
+# load), hot reload mid-load, SIGTERM drain -> exit 0, report renders.
+run_serve_stage() {
+    sv="$tmp/serve"
+    mkdir -p "$sv"
+
+    python - "$sv" <<'EOF'
+import sys
+import numpy as np
+from sparknet_tpu.proto import Message
+from sparknet_tpu.solver import Solver
+
+def mlp():
+    net = Message("NetParameter", name="mlp")
+    net.add("layer", name="d", type="JavaData", top=["data"],
+            java_data_param=dict(shape=dict(dim=[16, 8])))
+    net.add("layer", name="l", type="JavaData", top=["label"],
+            java_data_param=dict(shape=dict(dim=[16])))
+    net.add("layer", name="fc1", type="InnerProduct", bottom=["data"],
+            top=["fc1"], inner_product_param=dict(
+                num_output=16, weight_filler=dict(type="xavier")))
+    net.add("layer", name="r1", type="ReLU", bottom=["fc1"], top=["fc1"])
+    net.add("layer", name="fc2", type="InnerProduct", bottom=["fc1"],
+            top=["fc2"], inner_product_param=dict(
+                num_output=4, weight_filler=dict(type="xavier")))
+    net.add("layer", name="loss", type="SoftmaxWithLoss",
+            bottom=["fc2", "label"], top=["loss"])
+    return net
+
+sp = Message("SolverParameter", base_lr=0.1, lr_policy="fixed",
+             momentum=0.9, random_seed=7)
+s = Solver(sp, net_param=mlp(), log_fn=None)
+rs = np.random.RandomState(0)
+for _ in range(3):
+    s.train_step({"data": rs.randn(16, 8).astype(np.float32),
+                  "label": rs.randint(0, 4, 16).astype(np.int32)})
+s.snapshot(sys.argv[1] + "/snap")
+print("serve stage: snapshot at iter 3")
+EOF
+
+    python -m sparknet_tpu serve --prefix "$sv/snap" --port 0 \
+        --metrics "$sv/serve.jsonl" --reload_poll 0.5 \
+        > "$sv/serve.out" 2>&1 &
+    serve_pid=$!
+    for _ in $(seq 1 120); do
+        grep -q "listening on" "$sv/serve.out" && break
+        kill -0 "$serve_pid" || { echo "server died during startup:"
+                                  cat "$sv/serve.out"; exit 1; }
+        sleep 0.5
+    done
+    url=$(sed -n 's/.*listening on \(http:\/\/[^ ]*\).*/\1/p' \
+          "$sv/serve.out" | head -1)
+    test -n "$url" || { echo "server never announced:"
+                        cat "$sv/serve.out"; exit 1; }
+
+    # closed-loop bench under load; the snapshot advances mid-run so
+    # the hot reload happens with requests in flight
+    python -m sparknet_tpu serve-bench --url "$url" --mode closed \
+        --concurrency 4 --duration 6 --json "$sv/bench.json" \
+        > "$sv/bench.out" 2>&1 &
+    bench_pid=$!
+    sleep 1
+    python - "$sv" <<'EOF'
+import json, os, sys
+import numpy as np
+from sparknet_tpu.proto import Message
+from sparknet_tpu.solver import Solver
+from sparknet_tpu.resilience import load_manifest
+
+sv = sys.argv[1]
+man = load_manifest(sv + "/snap")
+
+def mlp():
+    net = Message("NetParameter", name="mlp")
+    net.add("layer", name="d", type="JavaData", top=["data"],
+            java_data_param=dict(shape=dict(dim=[16, 8])))
+    net.add("layer", name="l", type="JavaData", top=["label"],
+            java_data_param=dict(shape=dict(dim=[16])))
+    net.add("layer", name="fc1", type="InnerProduct", bottom=["data"],
+            top=["fc1"], inner_product_param=dict(
+                num_output=16, weight_filler=dict(type="xavier")))
+    net.add("layer", name="r1", type="ReLU", bottom=["fc1"], top=["fc1"])
+    net.add("layer", name="fc2", type="InnerProduct", bottom=["fc1"],
+            top=["fc2"], inner_product_param=dict(
+                num_output=4, weight_filler=dict(type="xavier")))
+    net.add("layer", name="loss", type="SoftmaxWithLoss",
+            bottom=["fc2", "label"], top=["loss"])
+    return net
+
+sp = Message("SolverParameter", base_lr=0.1, lr_policy="fixed",
+             momentum=0.9, random_seed=7)
+s = Solver(sp, net_param=mlp(), log_fn=None)
+s.restore(os.path.join(sv, man["latest"]["state"]))
+rs = np.random.RandomState(1)
+for _ in range(2):
+    s.train_step({"data": rs.randn(16, 8).astype(np.float32),
+                  "label": rs.randint(0, 4, 16).astype(np.int32)})
+s.snapshot(sv + "/snap")
+print("serve stage: advanced snapshot to iter 5 under load")
+EOF
+    wait "$bench_pid" || { echo "serve-bench failed:"
+                           cat "$sv/bench.out"; exit 1; }
+
+    python - "$sv" <<'EOF'
+import json, sys
+rows = json.load(open(sys.argv[1] + "/bench.json"))
+b = next(r for r in rows if r["mode"] == "closed")
+assert b["ok"] > 0, b
+assert b["rejected"] == 0, f"rejects at nominal load: {b}"
+assert b["errors"] == 0, f"errors under hot reload: {b}"
+assert b["latency_ms_p99"] < 2000, f"p99 blown: {b}"
+print(f"serve bench OK: {b['ok']} ok, p50={b['latency_ms_p50']}ms "
+      f"p99={b['latency_ms_p99']}ms, 0 rejects/errors across a reload")
+EOF
+    grep -q "hot-reloaded iter 5" "$sv/serve.out" || {
+        echo "no hot reload observed:"; cat "$sv/serve.out"; exit 1; }
+    curl -sf "$url/healthz" 2>/dev/null | grep -q '"iter": 5' || \
+    python -c "
+import json, urllib.request
+h = json.loads(urllib.request.urlopen('$url/healthz').read())
+assert h['iter'] == 5, h"
+
+    kill -TERM "$serve_pid"
+    rc=0; wait "$serve_pid" || rc=$?
+    test "$rc" -eq 0 || { echo "SIGTERM drain exited $rc:"
+                          cat "$sv/serve.out"; exit 1; }
+    grep -q "drained cleanly" "$sv/serve.out"
+
+    # the unservable-checkpoint path: documented exit 3, before binding
+    rc=0
+    python -m sparknet_tpu serve --prefix "$sv/definitely-missing" \
+        --port 0 > "$sv/bad.out" 2>&1 || rc=$?
+    test "$rc" -eq 3 || { echo "expected exit 3 on a bad checkpoint," \
+                               "got $rc"; cat "$sv/bad.out"; exit 1; }
+
+    python -m sparknet_tpu report "$sv/serve.jsonl" | tee "$sv/serve.rep" \
+        > /dev/null
+    grep -q "serving" "$sv/serve.rep"
+    grep -q "latency ms" "$sv/serve.rep"
+    grep -q "drained cleanly" "$sv/serve.rep"
+    python -m sparknet_tpu monitor "$sv/serve.jsonl" --once \
+        | grep -q "serving: requests"
+    echo "serve stage OK: bench clean across a live hot reload," \
+         "SIGTERM drained with exit 0, report rendered the section"
+}
+
+if [ "$stage" = "serve" ]; then
+    run_serve_stage
+    echo "SMOKE OK (serve)"
+    exit 0
+fi
 if [ "$stage" = "multihost" ]; then
     run_multihost_stage
     echo "SMOKE OK (multihost)"
@@ -357,5 +518,7 @@ echo "elasticity stage OK: eviction survived, quorum loss exits 4"
 run_async_stage
 
 run_multihost_stage
+
+run_serve_stage
 
 echo "SMOKE OK"

@@ -749,6 +749,72 @@ def cmd_monitor(args):
     return 0 if state.events else 2
 
 
+def cmd_serve(args):
+    """`sparknet serve`: weights-only inference over a resilient
+    checkpoint prefix — continuous batching, hot reload, graceful
+    drain. Exit 0 after a clean SIGTERM/SIGINT drain; exit 3
+    (EXIT_RECOVERY_ABORT) when the checkpoint has no servable model
+    blob, before the socket ever opens."""
+    from .utils.signals import SignalPolicy
+    from .utils.metrics import MetricsLogger
+    from .utils.exit_codes import EXIT_RECOVERY_ABORT
+    from .serve import ServeEngine, Batcher, serve_http
+
+    _apply_perf_flags(args)   # before any net is compiled
+    net_param = None
+    if args.model:
+        from .proto import text_format
+        net_param = text_format.load(args.model, "NetParameter")
+    metrics = MetricsLogger(args.metrics) if args.metrics else None
+    engine = ServeEngine(args.prefix, net_param=net_param,
+                         max_batch=args.max_batch, metrics=metrics)
+    try:
+        engine.load()
+    except ValueError as e:
+        print(f"sparknet serve: error: {e}", file=sys.stderr)
+        if metrics:
+            metrics.close()
+        return EXIT_RECOVERY_ABORT
+    if not args.no_warmup:
+        engine.warmup()           # trace every bucket before traffic
+    batcher = Batcher(max_batch=args.max_batch,
+                      max_wait_s=args.max_wait_ms / 1e3,
+                      queue_limit=args.queue_limit, metrics=metrics)
+    # SIGTERM = the scheduler's preemption notice -> drain, exit 0
+    policy = SignalPolicy(sigint="stop", sighup="none", sigterm="stop")
+    with policy:
+        rc = serve_http(engine, batcher, host=args.host, port=args.port,
+                        metrics=metrics, policy=policy,
+                        reload_poll_s=args.reload_poll,
+                        request_timeout_s=args.request_timeout)
+    if metrics:
+        metrics.close()
+    return rc
+
+
+def cmd_serve_bench(args):
+    """`sparknet serve-bench`: load-generate against a running
+    `sparknet serve` endpoint (closed and/or open loop)."""
+    from .utils.metrics import MetricsLogger
+    from .serve import run_loadgen
+
+    metrics = MetricsLogger(args.metrics) if args.metrics else None
+    modes = ("closed", "open") if args.mode == "both" else (args.mode,)
+    results = []
+    for mode in modes:
+        results.append(run_loadgen(
+            args.url, mode=mode, concurrency=args.concurrency,
+            rate=args.rate, duration_s=args.duration, rows=args.rows,
+            timeout=args.request_timeout, metrics=metrics))
+    if metrics:
+        metrics.close()
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(results, f, indent=1)
+    bad = sum(r["errors"] for r in results)
+    return 0 if bad == 0 else 1
+
+
 def _add_perf_flags(p, scan=False):
     """--remat (and for the LM driver --scan): the trace-time perf knobs
     of graph/compiler.py. The flags write the SPARKNET_* env vars before
@@ -1214,6 +1280,65 @@ def main(argv=None):
                     help="stop after this many seconds (default: forever)")
     mo.set_defaults(fn=cmd_monitor)
 
+    sv = sub.add_parser(
+        "serve",
+        help="serve a resilient checkpoint over HTTP: weights-only "
+             "load, continuous batching into power-of-two buckets, "
+             "hot reload on new snapshots, graceful SIGTERM drain")
+    sv.add_argument("--prefix", required=True,
+                    help="snapshot prefix (the training run's "
+                         "--snapshot_prefix; reads <prefix>.latest.json)")
+    sv.add_argument("--model",
+                    help="deploy/net prototxt (optional for binaryproto "
+                         "checkpoints — the model blob is "
+                         "self-describing; required for .h5)")
+    sv.add_argument("--host", default="127.0.0.1")
+    sv.add_argument("--port", type=int, default=0,
+                    help="0 = pick a free port (announced on stdout)")
+    sv.add_argument("--max_batch", type=int, default=8,
+                    help="largest padding bucket; buckets are powers "
+                         "of two up to this, one jit each")
+    sv.add_argument("--max_wait_ms", type=float, default=5.0,
+                    help="deadline: a batch closes once its oldest "
+                         "request waited this long, even unfilled")
+    sv.add_argument("--queue_limit", type=int, default=64,
+                    help="queued-row bound; submissions beyond it get "
+                         "429 (backpressure, not a latency tail)")
+    sv.add_argument("--reload_poll", type=float, default=2.0,
+                    help="seconds between manifest polls for hot "
+                         "reload (0 disables)")
+    sv.add_argument("--request_timeout", type=float, default=30.0,
+                    help="per-request inference timeout (504 past it)")
+    sv.add_argument("--no_warmup", action="store_true",
+                    help="skip tracing every bucket before traffic")
+    sv.add_argument("--metrics", help="JSONL metrics output path")
+    _add_perf_flags(sv, scan=True)
+    sv.set_defaults(fn=cmd_serve)
+
+    sb = sub.add_parser(
+        "serve-bench",
+        help="load-generate against a running `sparknet serve` "
+             "(closed loop = capacity, open loop = honest tail "
+             "latency at a fixed arrival rate)")
+    sb.add_argument("--url", required=True,
+                    help="server base URL, e.g. http://127.0.0.1:8080")
+    sb.add_argument("--mode", choices=("closed", "open", "both"),
+                    default="closed")
+    sb.add_argument("--concurrency", type=int, default=4,
+                    help="closed loop: workers with one request in "
+                         "flight each (also bounds open-loop dispatch)")
+    sb.add_argument("--rate", type=float, default=50.0,
+                    help="open loop: offered requests/second")
+    sb.add_argument("--duration", type=float, default=5.0,
+                    help="seconds per mode")
+    sb.add_argument("--rows", type=int, default=1,
+                    help="rows per request")
+    sb.add_argument("--request_timeout", type=float, default=10.0)
+    sb.add_argument("--metrics", help="JSONL metrics output path "
+                                      "(bench rows)")
+    sb.add_argument("--json", help="write per-mode summaries here")
+    sb.set_defaults(fn=cmd_serve_bench)
+
     li = sub.add_parser(
         "lint",
         help="static analysis: JAX hazard rules (host syncs/recompiles/"
@@ -1296,7 +1421,7 @@ def main(argv=None):
 
     args = p.parse_args(argv)
     if args.verb in ("train", "test", "time", "device_query", "cifar",
-                     "imagenet", "lm"):
+                     "imagenet", "lm", "serve"):
         # multi-host bootstrap (no-op single-process; SPARKNET_COORDINATOR
         # et al. select the jax.distributed rendezvous — see DEPLOY.md)
         from .parallel import distributed_init

@@ -12,7 +12,7 @@ Regenerate with:  python -m sparknet_tpu lint --write-event-schema
 
 EVENTS = {
     'bench': {
-        "fields": [],
+        "fields": ['kind'],
         "open": True,
     },
     'bench_config': {
@@ -119,6 +119,26 @@ EVENTS = {
         "fields": ['images_per_s', 'iter', 'loss', 'lr', 'round'],
         "open": False,
     },
+    'serve_batch': {
+        "fields": ['bucket', 'fill', 'infer_ms', 'iter', 'queue_depth', 'requests', 'size', 'wait_ms'],
+        "open": False,
+    },
+    'serve_reject': {
+        "fields": ['limit', 'queue_depth', 'reason'],
+        "open": False,
+    },
+    'serve_reload': {
+        "fields": ['from_iter', 'iter', 'model', 'ms'],
+        "open": False,
+    },
+    'serve_request': {
+        "fields": ['bucket', 'latency_ms', 'rows', 'wait_ms'],
+        "open": False,
+    },
+    'serve_summary': {
+        "fields": ['batch_fill', 'batches', 'drained', 'latency_ms_p50', 'latency_ms_p95', 'latency_ms_p99', 'rejects', 'reloads', 'requests', 'rows', 'rps', 'uptime_s'],
+        "open": False,
+    },
     'span': {
         "fields": [],
         "open": True,
@@ -157,6 +177,6 @@ EVENTS = {
     },
 }
 
-KINDS = ['abort', 'coordinated_restart', 'killed', 'mesh_shrunk', 'nan', 'params', 'quorum_lost', 'recovery_armed', 'resume', 'rollback', 'stall', 'summary', 'world_reset']
+KINDS = ['abort', 'coordinated_restart', 'killed', 'mesh_shrunk', 'nan', 'params', 'quorum_lost', 'recovery_armed', 'resume', 'rollback', 'serve', 'stall', 'summary', 'world_reset']
 
 KINDS_OPEN = True

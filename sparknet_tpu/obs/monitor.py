@@ -74,6 +74,18 @@ class MonitorState:
         self.host_gate = None       # last host_round event
         self.host_evictions = collections.Counter()
         self.coordinated_restart = None
+        # serving tier (serve/server.py, ISSUE 11)
+        self.serve_requests = 0
+        self.serve_rows = 0
+        self.serve_batches = 0
+        self.serve_rejects = 0
+        self.serve_reloads = 0
+        self.serve_fill_sum = 0.0
+        self.serve_lat_ms = collections.deque(maxlen=2048)
+        self.last_serve_batch = None
+        self.last_serve_reject = None
+        self.last_serve_reload = None
+        self.serve_summary = None
         self.done = None            # summary event, if the run finished
 
     def update(self, ev):               # spk: thread-entry
@@ -167,6 +179,27 @@ class MonitorState:
         elif kind == "host_evicted":
             if ev.get("host") is not None:
                 self.host_evictions[int(ev["host"])] += 1
+        elif kind == "serve_request":
+            self.serve_requests += 1
+            if _num(ev.get("rows")):
+                self.serve_rows += ev["rows"]
+            if _num(ev.get("latency_ms")):
+                self.serve_lat_ms.append(ev["latency_ms"])
+        elif kind == "serve_batch":
+            self.serve_batches += 1
+            if _num(ev.get("fill")):
+                self.serve_fill_sum += ev["fill"]
+            self.last_serve_batch = ev
+            if _num(ev.get("iter")):
+                self.iter = max(self.iter or 0, ev["iter"])
+        elif kind == "serve_reject":
+            self.serve_rejects += 1
+            self.last_serve_reject = ev
+        elif kind == "serve_reload":
+            self.serve_reloads += 1
+            self.last_serve_reload = ev
+        elif kind == "serve_summary":
+            self.serve_summary = ev
         elif kind == "summary":
             self.done = ev
 
@@ -285,6 +318,39 @@ class MonitorState:
                 L.append("    coordinated restart "
                          + ("AGREED" if cr.get("agreed") else "DISAGREED")
                          + f" across hosts {cr.get('hosts')}")
+        if self.serve_requests or self.serve_rejects or self.serve_summary:
+            from .stepstats import percentiles
+            bits = [f"requests {self.serve_requests}",
+                    f"batches {self.serve_batches}"]
+            if self.serve_rejects:
+                bits.append(f"rejects {self.serve_rejects}")
+            if self.serve_reloads:
+                bits.append(f"reloads {self.serve_reloads}")
+            if self.serve_lat_ms:
+                p = percentiles(list(self.serve_lat_ms))
+                bits.append(f"p50 {p['p50']:.1f}ms p99 {p['p99']:.1f}ms")
+            if self.serve_batches:
+                bits.append(
+                    f"fill {self.serve_fill_sum / self.serve_batches:.0%}")
+            L.append("  serving: " + "  ".join(bits))
+            sb = self.last_serve_batch
+            if sb is not None:
+                L.append(f"    last batch: {sb.get('size')} rows -> "
+                         f"bucket {sb.get('bucket')} "
+                         f"({sb.get('infer_ms')} ms, "
+                         f"depth {sb.get('queue_depth')})")
+            if self.last_serve_reload is not None:
+                r = self.last_serve_reload
+                L.append(f"    hot reload: iter {r.get('iter')} "
+                         f"(was {r.get('from_iter')}) in {r.get('ms')} ms")
+            if self.last_serve_reject is not None:
+                rj = self.last_serve_reject
+                L.append(f"    last reject: {rj.get('reason')} "
+                         f"(depth {rj.get('queue_depth')}/"
+                         f"{rj.get('limit')})")
+            if self.serve_summary is not None and \
+                    self.serve_summary.get("drained"):
+                L.append("    drained cleanly")
         if self.straggler_counts:
             worst = self.straggler_counts.most_common(1)[0]
             L.append(f"  stragglers: worker {worst[0]} flagged "

@@ -388,6 +388,48 @@ def aggregate(events):
     if bench:
         rep["bench"] = [{k: v for k, v in e.items()
                          if k not in ("event", "t", "run")} for e in bench]
+
+    # -- serving (sparknet_tpu.serve) --------------------------------------
+    sreq = [e for e in events if e.get("event") == "serve_request"]
+    sbat = [e for e in events if e.get("event") == "serve_batch"]
+    srej = [e for e in events if e.get("event") == "serve_reject"]
+    srel = [e for e in events if e.get("event") == "serve_reload"]
+    ssum = [e for e in events if e.get("event") == "serve_summary"]
+    if sreq or sbat or srej or srel or ssum:
+        sv = {"requests": len(sreq), "batches": len(sbat),
+              "rejects": len(srej), "reloads": len(srel)}
+        lats = [e["latency_ms"] for e in sreq if _num(e.get("latency_ms"))]
+        if lats:
+            sv.update({f"latency_ms_{k}": round(v, 3)
+                       for k, v in percentiles(lats).items()})
+        waits = [e["wait_ms"] for e in sreq if _num(e.get("wait_ms"))]
+        if waits:
+            sv["queue_wait_ms_p99"] = round(percentiles(waits)["p99"], 3)
+        fills = [e["fill"] for e in sbat if _num(e.get("fill"))]
+        if fills:
+            sv["batch_fill_mean"] = round(sum(fills) / len(fills), 4)
+        depths = [e["queue_depth"] for e in sbat
+                  if _num(e.get("queue_depth"))]
+        if depths:
+            sv["queue_depth_max"] = max(depths)
+        if sbat:
+            sv["buckets_used"] = sorted(
+                {e.get("bucket") for e in sbat if _num(e.get("bucket"))})
+        if srej:
+            sv["rejects_by_reason"] = dict(collections.Counter(
+                str(e.get("reason", "?")) for e in srej))
+        if srel:
+            sv["reload_iters"] = [e.get("iter") for e in srel][-10:]
+        if ssum:
+            # the drain-time flush aggregates the WHOLE run (the
+            # per-request stream caps its ring); prefer its totals
+            last = ssum[-1]
+            for k in ("requests", "rows", "rps", "batch_fill",
+                      "uptime_s", "drained", "latency_ms_p50",
+                      "latency_ms_p95", "latency_ms_p99"):
+                if last.get(k) is not None:
+                    sv[k] = last[k]
+        rep["serving"] = sv
     return rep
 
 
@@ -702,10 +744,50 @@ def render(rep):
         hdr("bench rows")
         for r in rep["bench"]:
             bits = [str(r.get("model", "?")), str(r.get("mode", ""))]
-            for k in ("images_per_sec", "tokens_per_sec", "mfu"):
+            for k in ("images_per_sec", "tokens_per_sec", "mfu",
+                      "rps", "latency_ms_p50", "latency_ms_p99"):
                 if _num(r.get(k)):
                     bits.append(f"{k}={r[k]}")
             L.append("  " + "  ".join(b for b in bits if b))
+    sv = rep.get("serving")
+    if sv:
+        hdr("serving")
+        line = f"  requests: {sv.get('requests', 0)}"
+        if _num(sv.get("rows")):
+            line += f" ({sv['rows']} rows)"
+        line += f", batches: {sv.get('batches', 0)}" \
+                f", rejects: {sv.get('rejects', 0)}" \
+                f", reloads: {sv.get('reloads', 0)}"
+        L.append(line)
+        ps = {q: sv.get(f"latency_ms_{q}") for q in ("p50", "p95", "p99")}
+        if any(_num(v) for v in ps.values()):
+            line = "  latency ms  " + "  ".join(
+                f"{q}={ps[q]:.3f}" for q in ("p50", "p95", "p99")
+                if _num(ps[q]))
+            if _num(sv.get("queue_wait_ms_p99")):
+                line += f"  (queue wait p99={sv['queue_wait_ms_p99']:.3f})"
+            L.append(line)
+        bits = []
+        if _num(sv.get("rps")):
+            bits.append(f"{sv['rps']} req/s")
+        if _num(sv.get("batch_fill_mean")):
+            bits.append(f"batch fill {sv['batch_fill_mean']:.0%}")
+        elif _num(sv.get("batch_fill")):
+            bits.append(f"batch fill {sv['batch_fill']:.0%}")
+        if sv.get("buckets_used"):
+            bits.append(f"buckets {sv['buckets_used']}")
+        if _num(sv.get("queue_depth_max")):
+            bits.append(f"max queue depth {sv['queue_depth_max']}")
+        if bits:
+            L.append("  " + ", ".join(bits))
+        if sv.get("rejects_by_reason"):
+            L.append("  rejects by reason: " + ", ".join(
+                f"{k}: {v}" for k, v in sorted(
+                    sv["rejects_by_reason"].items())))
+        if sv.get("reload_iters"):
+            L.append(f"  hot reloads to iters {sv['reload_iters']}")
+        if sv.get("drained"):
+            L.append("  drained cleanly")
     L.append("")
     return "\n".join(L)
 

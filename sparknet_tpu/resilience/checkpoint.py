@@ -282,6 +282,69 @@ def _verify_entry(d, entry):
     return None
 
 
+def _verify_model_entry(d, entry):
+    """Reason string this entry's MODEL blob is not servable, or None.
+
+    The weights-only half of _verify_entry: the serving path never
+    reads the optimizer-state file, so a missing or corrupt state blob
+    must not disqualify a snapshot whose model blob verifies."""
+    name = entry.get("model")
+    if not name:
+        return "manifest entry has no model file recorded"
+    path = os.path.join(d, name)
+    if not os.path.exists(path):
+        return f"model file {name} is missing"
+    if os.path.getsize(path) == 0:
+        return f"model file {name} is empty"
+    want = (entry.get("sha256") or {}).get("model")
+    if want and _sha256(path) != want:
+        return f"model file {name} fails its sha256 check " \
+               "(truncated or corrupt)"
+    return None
+
+
+def load_model_only(prefix, log_fn=None):
+    """Weights-only restore target: the newest manifest entry whose
+    MODEL blob verifies -> (model_path, entry). The optimizer-state
+    file is neither required nor read — a snapshot whose .solverstate
+    was pruned, torn, or never written still serves fine.
+
+    Raises ValueError naming the manifest when there is no manifest at
+    all or no entry's model blob verifies; every refused entry's reason
+    is in the message (and logged via ``log_fn``). Unlike the resume
+    path there is no legacy-pair fallback: serving trusts only
+    sha256-stamped manifests."""
+    log = log_fn or (lambda *a: None)
+    man_path = manifest_path(prefix)
+    man = load_manifest(prefix)
+    if man is None:
+        raise ValueError(
+            f"no checkpoint manifest at {man_path} (missing, torn, or "
+            "corrupt) — run `sparknet train` with snapshotting enabled, "
+            "or point --prefix at an existing manifest")
+    d = os.path.dirname(prefix)
+    refused = []
+    entries = [man.get("latest")] + list(reversed(man.get("snapshots", [])))
+    seen = set()
+    for entry in entries:
+        if not isinstance(entry, dict):
+            continue
+        key = (entry.get("iter"), entry.get("model"))
+        if key in seen:
+            continue
+        seen.add(key)
+        reason = _verify_model_entry(d, entry)
+        if reason is None:
+            for name, r in refused:
+                log(f"refusing model blob {name}: {r}")
+            return os.path.join(d, entry.get("model")), entry
+        refused.append((entry.get("model") or "?", reason))
+    detail = "; ".join(f"{name}: {r}" for name, r in refused) \
+        or "manifest records no snapshots"
+    raise ValueError(
+        f"manifest {man_path} has no servable model blob ({detail})")
+
+
 _ITER_RE = re.compile(r"_iter_(\d+)\.solverstate(\.h5)?$")
 
 

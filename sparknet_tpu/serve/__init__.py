@@ -1,0 +1,19 @@
+"""`sparknet serve` — a continuous-batching inference tier over
+resilient checkpoints.
+
+engine.py   weights-only checkpoint loading into forward-only jits,
+            one per padding bucket, with hot reload mid-serve
+batcher.py  thread-safe request queue: continuous batching, pad-to-
+            bucket, max-wait deadline, bounded-queue backpressure
+server.py   stdlib HTTP front end (/predict /healthz /metrics) with
+            graceful SIGTERM drain and the supervisor exit contract
+loadgen.py  closed- and open-loop load generator (`sparknet serve-bench`)
+"""
+
+from .engine import ServeEngine, bucket_sizes, bucket_for
+from .batcher import Batcher, RejectedError
+from .server import ServeStats, serve_http
+from .loadgen import run_loadgen
+
+__all__ = ["ServeEngine", "bucket_sizes", "bucket_for", "Batcher",
+           "RejectedError", "ServeStats", "serve_http", "run_loadgen"]

@@ -1,0 +1,297 @@
+"""Weights-only serving engine over resilient checkpoints.
+
+The training side writes sha256-stamped atomic manifests
+(resilience/checkpoint.py); the engine consumes them through
+load_model_only — the optimizer-state file is never read, so a
+snapshot whose .solverstate was pruned or torn still serves.
+
+The forward path is a TEST-phase CompiledNet jitted once PER PADDING
+BUCKET (powers of two up to --max_batch): every request batch is
+padded up to the nearest bucket, so the jit cache holds at most
+log2(max_batch)+1 entries no matter what batch sizes arrive — the
+same bounded-recompile discipline `sparknet lint` SPK102 enforces on
+training feeds. The jit takes (params, state, batch) and returns only
+the output blobs — params flow in every call and are reused, which is
+exactly the eval shape SPK105 exempts from donation.
+
+Hot reload: poll_reload() re-reads `<prefix>.latest.json` between
+batches; when the manifest names a newer model blob that verifies, the
+new weights are loaded OFF the serving path and swapped in under the
+status lock as one reference assignment — in-flight batches keep the
+params they captured, later batches see the new ones, and a torn or
+corrupt manifest/blob keeps the old weights serving.
+"""
+
+import time
+
+import numpy as np
+
+
+def bucket_sizes(max_batch):
+    """Powers-of-two padding buckets, max_batch always included last."""
+    sizes, b = [], 1
+    while b < int(max_batch):
+        sizes.append(b)
+        b *= 2
+    sizes.append(int(max_batch))
+    return sizes
+
+
+def bucket_for(n, sizes):
+    """Smallest bucket >= n, or None when n exceeds the largest."""
+    for b in sizes:
+        if n <= b:
+            return b
+    return None
+
+
+def _feed_dtype(name, shape):
+    if len(shape) <= 1 or "label" in name:
+        return np.int32
+    return np.float32
+
+
+_FEED_TYPES = ("JavaData", "Data", "DummyData", "Input", "MemoryData",
+               "HDF5Data", "ImageData", "WindowData")
+
+
+def deploy_net_param(net_param):
+    """Train prototxt -> deploy net: drop loss/accuracy layers (their
+    logit bottoms become net outputs — the blobs /predict returns) and
+    feed layers nothing consumes afterwards (the label feed). A net
+    that is already deploy-shaped passes through unchanged."""
+    np_ = net_param.copy()
+    kept = [lp for lp in np_.layer
+            if "loss" not in lp.type.lower()
+            and "accuracy" not in lp.type.lower()]
+    used = set()
+    for lp in kept:
+        used.update(str(b) for b in lp.bottom)
+    kept = [lp for lp in kept
+            if lp.type not in _FEED_TYPES or not len(lp.top)
+            or any(str(t) in used for t in lp.top)]
+    np_.layer.clear()
+    for lp in kept:
+        np_.layer.append(lp)
+    return np_
+
+
+class ServeEngine:
+    def __init__(self, prefix, net_param=None, max_batch=8,
+                 metrics=None, log_fn=print):
+        import threading
+        self.prefix = prefix
+        self.max_batch = int(max_batch)
+        self.buckets = bucket_sizes(self.max_batch)
+        self.metrics = metrics
+        self.log = log_fn or (lambda *a: None)
+        self._net_param = net_param       # template; None -> from checkpoint
+        self._lock = threading.Lock()
+        self._params = None               # spk: guarded-by=_lock
+        self._state = None                # spk: guarded-by=_lock
+        self._loaded = None               # spk: guarded-by=_lock
+        self._reloads = 0                 # spk: guarded-by=_lock
+        self._nets = {}                   # bucket -> CompiledNet (serve thread)
+        self._fwd = {}                    # bucket -> jitted forward
+        self._base = None                 # probe net: shapes + weight loading
+        self._base_shapes = None          # feed blob -> full-batch shape
+
+    # -- loading -----------------------------------------------------------
+
+    def load(self):
+        """Initial weights-only load; raises ValueError (naming the
+        manifest) when no servable model blob exists."""
+        from ..resilience import checkpoint
+        model_path, entry = checkpoint.load_model_only(
+            self.prefix, log_fn=self.log)
+        params, state = self._load_params(model_path)
+        with self._lock:
+            self._params, self._state = params, state
+            self._loaded = entry
+        self.log(f"serve: loaded iter {entry.get('iter')} "
+                 f"from {model_path}")
+        return entry
+
+    def _load_params(self, model_path):
+        """(params, state) from one model blob. Builds the probe net on
+        first use — for binaryproto checkpoints the blob is a full
+        NetParameter, so no --model prototxt is needed."""
+        import jax
+        from ..proto import wire
+        from ..graph.compiler import CompiledNet, TEST
+        if model_path.endswith(".h5"):
+            if self._net_param is None:
+                raise ValueError(
+                    f"checkpoint {model_path} is HDF5 (weights only, no "
+                    "net structure) — pass --model <deploy prototxt>")
+            net_proto = None
+        else:
+            net_proto = wire.load(model_path, "NetParameter")
+            if self._net_param is None:
+                self._net_param = net_proto.copy()
+        if self._base is None:
+            self._net_param = deploy_net_param(self._net_param)
+            self._base = CompiledNet(self._net_param.copy(), TEST)
+            self._base_shapes = {
+                n: tuple(s) for n, s in self._base.feed_shapes().items()}
+        params, state = self._base.init(jax.random.PRNGKey(0))
+        if net_proto is None:
+            from ..solver import hdf5_io
+            params = hdf5_io.load_net_hdf5(model_path, self._base, params)
+        else:
+            params, state = self._base.load_netproto(net_proto, params,
+                                                     state)
+        return params, state
+
+    # -- per-bucket compiled forwards --------------------------------------
+
+    def _bucket_net(self, b):
+        from ..graph.compiler import CompiledNet, TEST
+        net = self._nets.get(b)
+        if net is None:
+            np_b = self._net_param.copy()
+            # deploy nets size their net-level inputs from input_shape;
+            # feed layers take the feed_shapes override — rewrite both
+            # to this bucket's leading dim
+            for s in np_b.input_shape:
+                if len(s.dim):
+                    s.dim[0] = b
+            for i in range(0, len(np_b.input_dim), 4):
+                np_b.input_dim[i] = b
+            shapes = {n: (b,) + tuple(base[1:])
+                      for n, base in self._base_shapes.items()}
+            net = CompiledNet(np_b, TEST, feed_shapes=shapes)
+            self._nets[b] = net
+        return net
+
+    def _bucket_fwd(self, b):
+        import jax
+        fwd = self._fwd.get(b)
+        if fwd is None:
+            net = self._bucket_net(b)
+            outs = list(net.output_blobs)
+
+            def run(params, state, batch):
+                blobs, _ = net.apply(params, state, batch, train=False)
+                return {k: blobs[k] for k in outs if k in blobs}
+
+            fwd = jax.jit(run)
+            self._fwd[b] = fwd
+        return fwd
+
+    def warmup(self):
+        """Trace every bucket once so first requests don't pay compile."""
+        for b in self.buckets:
+            self.forward({}, n=b)
+
+    def feed_shapes(self):
+        """{feed blob -> per-sample shape (leading dim stripped)}."""
+        if self._base_shapes is None:
+            raise RuntimeError("engine not loaded")
+        return {n: tuple(s[1:]) for n, s in self._base_shapes.items()}
+
+    # -- forward -----------------------------------------------------------
+
+    def forward(self, batch, n=None):
+        """Pad ``batch`` ({feed blob -> array, leading dim = rows}) to
+        its bucket, run the bucket's jit, slice outputs back to the
+        real row count. Missing feed blobs (labels on a train-style
+        prototxt) are zero-filled. Returns (outputs, bucket)."""
+        if n is None:
+            n = max((int(np.shape(v)[0]) for v in batch.values()),
+                    default=1)
+        b = bucket_for(n, self.buckets)
+        if b is None:
+            raise ValueError(
+                f"batch of {n} rows exceeds max_batch={self.max_batch}")
+        padded = {}
+        for name, base in self._base_shapes.items():
+            target = (b,) + tuple(base[1:])
+            dt = _feed_dtype(name, base)
+            arr = batch.get(name)
+            if arr is None:
+                padded[name] = np.zeros(target, dt)
+                continue
+            arr = np.asarray(arr, dt)
+            if arr.shape[1:] != target[1:]:
+                raise ValueError(
+                    f"feed {name!r}: per-sample shape {arr.shape[1:]} "
+                    f"!= expected {target[1:]}")
+            if arr.shape[0] < b:
+                pad = np.zeros((b - arr.shape[0],) + target[1:], dt)
+                arr = np.concatenate([arr, pad], axis=0)
+            padded[name] = arr
+        fwd = self._bucket_fwd(b)
+        with self._lock:
+            params, state = self._params, self._state
+        out = fwd(params, state, padded)
+        res = {}
+        for k, v in out.items():
+            a = np.asarray(v)
+            # batch-shaped outputs are sliced back to the real rows;
+            # scalars (a train prototxt's loss over the padded batch)
+            # pass through untouched
+            res[k] = a[:n] if a.ndim and a.shape[0] == b else a
+        return res, b
+
+    # -- hot reload --------------------------------------------------------
+
+    def poll_reload(self):
+        """Swap in the manifest's newest servable weights when they
+        differ from what is loaded; returns the new entry or None.
+        Every failure path (torn manifest, missing/corrupt blob) keeps
+        the current weights serving."""
+        from ..resilience import checkpoint
+        man = checkpoint.load_manifest(self.prefix)
+        latest = (man or {}).get("latest")
+        if not isinstance(latest, dict):
+            return None
+        with self._lock:
+            loaded = self._loaded
+        if loaded is not None and \
+                latest.get("iter") == loaded.get("iter") and \
+                latest.get("sha256") == loaded.get("sha256"):
+            return None
+        try:
+            model_path, entry = checkpoint.load_model_only(
+                self.prefix, log_fn=self.log)
+        except (OSError, ValueError) as e:
+            self.log(f"serve: reload skipped ({e}); keeping "
+                     f"iter {None if loaded is None else loaded.get('iter')}")
+            return None
+        if loaded is not None and \
+                entry.get("iter") == loaded.get("iter") and \
+                entry.get("sha256") == loaded.get("sha256"):
+            return None          # newest SERVABLE blob is what we have
+        t0 = time.perf_counter()
+        try:
+            params, state = self._load_params(model_path)
+        except (OSError, ValueError, KeyError) as e:
+            self.log(f"serve: reload of {model_path} failed ({e}); "
+                     "keeping current weights")
+            return None
+        from_iter = None if loaded is None else loaded.get("iter")
+        with self._lock:
+            self._params, self._state = params, state
+            self._loaded = entry
+            self._reloads += 1
+        ms = (time.perf_counter() - t0) * 1e3
+        self.log(f"serve: hot-reloaded iter {entry.get('iter')} "
+                 f"(was {from_iter}) in {ms:.0f} ms")
+        if self.metrics is not None:
+            self.metrics.log("serve_reload", iter=entry.get("iter"),
+                             from_iter=from_iter,
+                             model=entry.get("model"), ms=round(ms, 3))
+        return entry
+
+    def status(self):        # spk: thread-entry
+        """Snapshot for /healthz (called from HTTP handler threads)."""
+        with self._lock:
+            loaded, reloads = self._loaded, self._reloads
+        return {
+            "iter": None if loaded is None else loaded.get("iter"),
+            "model": None if loaded is None else loaded.get("model"),
+            "reloads": reloads,
+            "buckets": list(self.buckets),
+            "feeds": {n: list(s) for n, s in self.feed_shapes().items()},
+        }

@@ -1,0 +1,304 @@
+"""HTTP front end + serve loop for `sparknet serve`.
+
+stdlib-only: a ThreadingHTTPServer owns the sockets (one handler
+thread per connection), the MAIN thread runs serve_loop() — form a
+batch, run the engine, fulfill the handler threads' Request events.
+Endpoints:
+
+  POST /predict   {"<feed blob>": [[...]...]} -> {"outputs": {...}}
+                  (a bare list is taken as the first feed blob)
+  GET  /healthz   loaded iter/model, buckets, feed shapes, queue depth
+  GET  /metrics   latency percentiles + counters snapshot
+
+Supervisor contract (DEPLOY.md "Serving"): SIGTERM/SIGINT stop
+accepting (backpressure 429s), drain queued requests, exit
+EXIT_OK(0). A checkpoint that cannot load exits EXIT_RECOVERY_ABORT(3)
+before the socket ever opens, so an orchestrator's restart loop can
+tell "bad checkpoint" from "crash".
+
+Every batch emits schema-registered events (serve_request,
+serve_batch, serve_reject, serve_reload, serve_summary) so `sparknet
+report`/`monitor` render the serving section with no special cases.
+"""
+
+import json
+import threading
+import time
+
+import numpy as np
+
+from .batcher import RejectedError
+
+
+class ServeStats:
+    # spk: guarded-by-default=_lock
+    def __init__(self, window=4096):
+        import collections
+        self._lock = threading.Lock()
+        self.t0 = time.monotonic()
+        self.lat_ms = collections.deque(maxlen=window)
+        self.requests = 0
+        self.rows = 0
+        self.batches = 0
+        self.fill_sum = 0.0
+        self.rejects = 0
+        self.reloads = 0
+
+    def record_batch(self, reqs, bucket, infer_ms):
+        now = time.monotonic()
+        with self._lock:
+            self.batches += 1
+            rows = sum(r.n for r in reqs)
+            self.rows += rows
+            self.requests += len(reqs)
+            self.fill_sum += rows / float(bucket)
+            for r in reqs:
+                self.lat_ms.append((now - r.t_submit) * 1e3)
+
+    def record_reject(self):              # spk: thread-entry
+        with self._lock:
+            self.rejects += 1
+
+    def record_reload(self):
+        with self._lock:
+            self.reloads += 1
+
+    def snapshot(self):                   # spk: thread-entry
+        from ..obs.stepstats import percentiles
+        with self._lock:
+            lats = list(self.lat_ms)
+            out = {
+                "requests": self.requests,
+                "rows": self.rows,
+                "batches": self.batches,
+                "rejects": self.rejects,
+                "reloads": self.reloads,
+                "uptime_s": round(time.monotonic() - self.t0, 3),
+                "batch_fill": round(
+                    self.fill_sum / self.batches, 4) if self.batches
+                else None,
+            }
+        lat = {f"latency_ms_{k}": round(v, 3)
+               for k, v in percentiles(lats).items()} if lats else {}
+        out.update(lat)
+        if out["uptime_s"] > 0:
+            out["rps"] = round(out["requests"] / out["uptime_s"], 2)
+        return out
+
+
+def _make_handler(engine, batcher, stats, timeout_s):
+    from http.server import BaseHTTPRequestHandler
+
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *args):   # quiet access log
+            pass
+
+        def _send_json(self, code, obj):
+            body = json.dumps(obj).encode("utf-8")
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            if self.path == "/healthz":
+                st = engine.status()
+                st["status"] = "ok"
+                st["queue_depth"] = batcher.depth()
+                self._send_json(200, st)
+            elif self.path == "/metrics":
+                snap = stats.snapshot()
+                snap["queue_depth"] = batcher.depth()
+                snap.update(batcher.counters())
+                self._send_json(200, snap)
+            else:
+                self._send_json(404, {"error": "unknown path"})
+
+        def do_POST(self):
+            if self.path != "/predict":
+                self._send_json(404, {"error": "unknown path"})
+                return
+            try:
+                length = int(self.headers.get("Content-Length", 0))
+                payload = json.loads(self.rfile.read(length) or b"{}")
+            except (ValueError, TypeError) as e:
+                self._send_json(400, {"error": f"bad JSON: {e}"})
+                return
+            try:
+                arrays, n = _parse_inputs(payload, engine.feed_shapes())
+            except ValueError as e:
+                self._send_json(400, {"error": str(e)})
+                return
+            try:
+                req = batcher.submit(arrays, n=n)
+            except RejectedError as e:
+                stats.record_reject()
+                self._send_json(429, {"error": str(e),
+                                      "reason": e.reason,
+                                      "queue_depth": e.queue_depth})
+                return
+            if not req.wait(timeout_s):
+                self._send_json(504, {"error": "inference timed out"})
+                return
+            if req.error is not None:
+                self._send_json(500, {"error": req.error})
+                return
+            self._send_json(200, {
+                "outputs": {k: v.tolist() for k, v in req.result.items()},
+                "iter": engine.status().get("iter"),
+                "bucket": req.bucket,
+                "latency_ms": round((req.t_done - req.t_submit) * 1e3, 3),
+            })
+
+    return Handler
+
+
+def _parse_inputs(payload, feed_shapes):
+    """JSON body -> ({feed blob -> ndarray}, rows). A bare list feeds
+    the first (primary) blob; labels and other feeds default to
+    zero-fill in the engine."""
+    names = list(feed_shapes)
+    if not names:
+        raise ValueError("net has no feed blobs")
+    if isinstance(payload, list):
+        payload = {names[0]: payload}
+    if not isinstance(payload, dict) or not payload:
+        raise ValueError(
+            f"expected a JSON object keyed by feed blob {names}")
+    arrays, n = {}, None
+    for k, v in payload.items():
+        if k not in feed_shapes:
+            raise ValueError(f"unknown feed blob {k!r} (have {names})")
+        arr = np.asarray(v)
+        per = tuple(feed_shapes[k])
+        if arr.shape == per:        # single sample without batch dim
+            arr = arr[None]
+        if arr.shape[1:] != per:
+            raise ValueError(
+                f"feed {k!r}: per-sample shape {arr.shape[1:]} != {per}")
+        if n is None:
+            n = arr.shape[0]
+        elif arr.shape[0] != n:
+            raise ValueError("feed blobs disagree on row count")
+        arrays[k] = arr
+    return arrays, int(n)
+
+
+def _run_batch(engine, batcher, stats, metrics, reqs, wait_ms):
+    """One engine step for one closed batch; fulfills every Request."""
+    rows = sum(r.n for r in reqs)
+    depth = batcher.depth()
+    arrays = {}
+    for name, per in engine.feed_shapes().items():
+        if not any(name in r.arrays for r in reqs):
+            continue                # engine zero-fills the whole feed
+        parts = [np.asarray(r.arrays[name]) if name in r.arrays
+                 else np.zeros((r.n,) + tuple(per))
+                 for r in reqs]
+        arrays[name] = np.concatenate(parts, axis=0)
+    t0 = time.perf_counter()
+    try:
+        out, bucket = engine.forward(arrays, n=rows)
+    except Exception as e:          # net-level failure -> 500s, keep serving
+        for r in reqs:
+            r.error = f"{type(e).__name__}: {e}"
+            r.t_done = time.monotonic()
+            r.done.set()
+        return
+    infer_ms = (time.perf_counter() - t0) * 1e3
+    off = 0
+    now = time.monotonic()
+    for r in reqs:
+        r.result = {k: v[off:off + r.n] for k, v in out.items()}
+        r.bucket = bucket
+        r.t_done = now
+        off += r.n
+        r.done.set()
+    stats.record_batch(reqs, bucket, infer_ms)
+    if metrics is not None:
+        it = engine.status().get("iter")
+        metrics.log("serve_batch", size=rows, requests=len(reqs),
+                    bucket=bucket, fill=round(rows / float(bucket), 4),
+                    queue_depth=depth, wait_ms=round(wait_ms, 3),
+                    infer_ms=round(infer_ms, 3), iter=it)
+        for r in reqs:
+            metrics.log("serve_request",
+                        latency_ms=round((r.t_done - r.t_submit) * 1e3, 3),
+                        wait_ms=round(wait_ms, 3), rows=r.n,
+                        bucket=bucket)
+
+
+def serve_loop(engine, batcher, stats, metrics=None, policy=None,
+               reload_poll_s=0.0, stop_event=None, idle_timeout=0.05,
+               log_fn=print):
+    """The single consumer thread: batches, signals, hot reload, drain.
+    Returns 0 after a clean drain (the supervisor contract)."""
+    log = log_fn or (lambda *a: None)
+    next_reload = time.monotonic() + reload_poll_s if reload_poll_s else None
+    draining = False
+    while True:
+        if not draining:
+            action = policy.pending() if policy is not None else None
+            if action is not None and "stop" in action:
+                log("serve: stop requested; draining "
+                    f"{batcher.pending()} queued request(s)")
+                batcher.close()
+                draining = True
+            elif stop_event is not None and stop_event.is_set():
+                batcher.close()
+                draining = True
+        if next_reload is not None and not draining \
+                and time.monotonic() >= next_reload:
+            if engine.poll_reload() is not None:
+                stats.record_reload()
+            next_reload = time.monotonic() + reload_poll_s
+        reqs, wait_ms = batcher.next_batch(timeout=idle_timeout)
+        if reqs:
+            _run_batch(engine, batcher, stats, metrics, reqs, wait_ms)
+        elif draining and batcher.pending() == 0:
+            return 0
+
+
+def serve_http(engine, batcher, host="127.0.0.1", port=0, metrics=None,
+               policy=None, reload_poll_s=0.0, stop_event=None,
+               request_timeout_s=30.0, log_fn=print):
+    """Bind, announce, serve until drained; returns the exit code."""
+    from http.server import ThreadingHTTPServer
+    log = log_fn or (lambda *a: None)
+    stats = ServeStats()
+    handler = _make_handler(engine, batcher, stats, request_timeout_s)
+    httpd = ThreadingHTTPServer((host, int(port)), handler)
+    httpd.daemon_threads = True
+    addr = f"http://{httpd.server_address[0]}:{httpd.server_address[1]}"
+    st = engine.status()
+    log(f"sparknet serve: listening on {addr} (iter {st.get('iter')}, "
+        f"buckets {st.get('buckets')})")
+    import sys
+    sys.stdout.flush()      # the announce line gates smoke/loadgen start
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    try:
+        rc = serve_loop(engine, batcher, stats, metrics=metrics,
+                        policy=policy, reload_poll_s=reload_poll_s,
+                        stop_event=stop_event, log_fn=log)
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+    snap = stats.snapshot()
+    if metrics is not None:
+        metrics.log("serve_summary", requests=snap.get("requests"),
+                    rows=snap.get("rows"), batches=snap.get("batches"),
+                    rejects=snap.get("rejects"),
+                    reloads=snap.get("reloads"),
+                    rps=snap.get("rps"),
+                    latency_ms_p50=snap.get("latency_ms_p50"),
+                    latency_ms_p95=snap.get("latency_ms_p95"),
+                    latency_ms_p99=snap.get("latency_ms_p99"),
+                    batch_fill=snap.get("batch_fill"),
+                    uptime_s=snap.get("uptime_s"), drained=True)
+    log(f"serve: drained cleanly after {snap.get('requests', 0)} "
+        f"request(s); exiting 0")
+    return rc

@@ -1,0 +1,52 @@
+"""SPK105 serving-tier corpus — pins the eval-style exemption for the
+shapes serve/engine.py actually jits.
+
+Never imported at runtime; `sparknet lint` only parses it. The serving
+forward takes (params, state, batch) and returns ONLY output blobs —
+params flow in on every call and are reused across requests, so
+donating them would free buffers the next batch still needs. SPK105
+must stay quiet on every serve-shaped function here; the one
+update-shaped contrast at the bottom pins that the rule still fires
+when params are carried through. Expected findings are asserted
+line-exactly in tests/test_lint.py, so EDITS HERE MUST UPDATE THAT
+TEST.
+"""
+
+import jax
+
+
+def serve_bucket_forward(net):
+    # the per-bucket jit `sparknet serve` builds: blobs out, nothing
+    # state-named returned -> exempt by construction, no annotation
+    def run(params, state, batch):
+        blobs, _ = net.apply(params, state, batch, train=False)
+        return {k: blobs[k] for k in net.output_blobs if k in blobs}
+    return jax.jit(run)
+
+
+def serve_single_logits(net, out_name):
+    # single-output variant (subscript return, still not a carried Name)
+    def run(params, state, batch):
+        blobs, _ = net.apply(params, state, batch, train=False)
+        return blobs[out_name]
+    return jax.jit(run)
+
+
+def serve_with_new_state(net):
+    # a stateful serving net (e.g. BN running stats in TEST phase)
+    # returns DERIVED state, not the `state` argument itself — reusing
+    # the input params/state next call is still correct, so no finding
+    def run(params, state, batch):
+        blobs, new_state = net.apply(params, state, batch, train=False)
+        return blobs, new_state
+    return jax.jit(run)
+
+
+def train_step_contrast(updater):
+    # the update shape the rule exists for: params in AND out, no
+    # donation -> one finding, proving the serve exemption is an
+    # exemption and not a dead rule
+    def step(params, state, batch):
+        params = updater(params, batch)
+        return params, state
+    return jax.jit(step)                    # SPK105 no donation

@@ -68,6 +68,15 @@ class TestSchemaFreshness:
                      "watchdog", "summary"):
             assert name in event_schema.EVENTS, name
 
+    def test_serve_events_registered(self):
+        # the serving tier (serve/) emits through the same registry —
+        # its events are closed (fixed kwargs at every emit site)
+        for name in ("serve_request", "serve_batch", "serve_reject",
+                     "serve_reload", "serve_summary"):
+            assert name in event_schema.EVENTS, name
+            assert not event_schema.EVENTS[name]["open"], name
+        assert "serve" in event_schema.KINDS  # loadgen's bench rows
+
 
 class TestConsumersUseRegisteredNames:
     def test_consumer_event_filters_are_registered(self):
@@ -81,6 +90,20 @@ class TestConsumersUseRegisteredNames:
                 assert name in known, (
                     f"obs/{fname} filters on event {name!r} that "
                     f"nothing emits — typo, or regenerate the schema")
+
+    def test_serve_consumers_filter_serve_events(self):
+        # report.py and monitor.py both render the serving section;
+        # pin that they really filter on the serve events (so the
+        # registered-names check above isn't vacuously true for them)
+        for fname in CONSUMERS:
+            with open(os.path.join(OBS, fname), encoding="utf-8") as f:
+                src = f.read()
+            seen = {name for domain, name in consumed_names(src)
+                    if domain == "event"}
+            for name in ("serve_request", "serve_batch",
+                         "serve_reject", "serve_reload",
+                         "serve_summary"):
+                assert name in seen, (fname, name)
 
     def test_consumer_kind_filters_are_registered(self):
         if event_schema.KINDS_OPEN:

@@ -76,6 +76,19 @@ class TestJaxRuleCorpus:
     def test_clean_fixture_is_clean(self):
         assert fixture_findings("clean.py") == []
 
+    def test_serve_shaped_jits_are_exempt(self):
+        # serving forwards (params/state in, output blobs out — what
+        # serve/engine.py jits per bucket) must never be asked to
+        # donate; only the update-shaped contrast at the bottom fires
+        got = code_lines(fixture_findings("serve_jit.py"))
+        assert got == [
+            ("SPK105", 52),      # train-shaped contrast: carries params
+        ]
+        quiet = {"serve_bucket_forward", "serve_single_logits",
+                 "serve_with_new_state"}
+        for f in fixture_findings("serve_jit.py"):
+            assert f.symbol.split(".")[0] not in quiet, f
+
     def test_negatives_do_not_fire(self):
         # the ok/suppressed halves of every fixture stay quiet: no
         # finding may anchor inside any of these functions

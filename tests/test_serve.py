@@ -1,0 +1,496 @@
+"""Serving-tier tests (sparknet_tpu.serve, ISSUE 11).
+
+The contract under test: `sparknet serve` answers over weights-only
+checkpoint loads (the optimizer state is never needed and may be
+gone), pads every batch to a power-of-two bucket whose logits match an
+unpadded forward to fp32 roundoff, flushes partial batches at the
+max-wait deadline, rejects with backpressure instead of queueing
+unboundedly, hot-reloads newer snapshots without dropping in-flight
+work, drains on SIGTERM with exit 0, and — because serving only ever
+READS the checkpoint dir — leaves no partial state when killed.
+"""
+
+import json
+import os
+import re
+import shutil
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from sparknet_tpu.proto import Message
+from sparknet_tpu.solver import Solver
+from sparknet_tpu.resilience import load_manifest, manifest_path
+from sparknet_tpu.resilience.checkpoint import load_model_only
+from sparknet_tpu.serve import (Batcher, RejectedError, ServeEngine,
+                                bucket_for, bucket_sizes)
+from sparknet_tpu.serve.engine import deploy_net_param
+from sparknet_tpu.serve.server import _parse_inputs
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+
+
+def _mlp_net():
+    net = Message("NetParameter", name="mlp")
+    net.add("layer", name="d", type="JavaData", top=["data"],
+            java_data_param=dict(shape=dict(dim=[16, 8])))
+    net.add("layer", name="l", type="JavaData", top=["label"],
+            java_data_param=dict(shape=dict(dim=[16])))
+    net.add("layer", name="fc1", type="InnerProduct", bottom=["data"],
+            top=["fc1"], inner_product_param=dict(
+                num_output=16, weight_filler=dict(type="xavier")))
+    net.add("layer", name="r1", type="ReLU", bottom=["fc1"], top=["fc1"])
+    net.add("layer", name="fc2", type="InnerProduct", bottom=["fc1"],
+            top=["fc2"], inner_product_param=dict(
+                num_output=4, weight_filler=dict(type="xavier")))
+    net.add("layer", name="loss", type="SoftmaxWithLoss",
+            bottom=["fc2", "label"], top=["loss"])
+    return net
+
+
+def _train_and_snapshot(prefix, iters=3, seed=0):
+    sp = Message("SolverParameter", base_lr=0.1, lr_policy="fixed",
+                 momentum=0.9, random_seed=7)
+    s = Solver(sp, net_param=_mlp_net(), log_fn=None)
+    rs = np.random.RandomState(seed)
+    for _ in range(iters):
+        s.train_step({"data": rs.randn(16, 8).astype(np.float32),
+                      "label": rs.randint(0, 4, 16).astype(np.int32)})
+    s.snapshot(prefix)
+    return s
+
+
+@pytest.fixture(scope="module")
+def snap_dir(tmp_path_factory):
+    """One trained snapshot shared read-only by the module; tests that
+    mutate checkpoint state copy it first."""
+    d = tmp_path_factory.mktemp("serve_snap")
+    _train_and_snapshot(str(d / "snap"))
+    return str(d)
+
+
+def _copy_snap(snap_dir, tmp_path):
+    d = tmp_path / "snap_copy"
+    shutil.copytree(snap_dir, d)
+    return str(d / "snap")
+
+
+class _Sink:
+    """Event recorder with the metrics .log signature."""
+
+    def __init__(self):
+        self.rows = []
+
+    def log(self, event, **kw):
+        self.rows.append(dict(kw, event=event))
+
+    def events(self, name):
+        return [r for r in self.rows if r["event"] == name]
+
+
+# ------------------------------------------------------------- buckets ----
+
+class TestBuckets:
+    def test_bucket_sizes_powers_of_two(self):
+        assert bucket_sizes(8) == [1, 2, 4, 8]
+        assert bucket_sizes(1) == [1]
+        # a non-power max is still included as the terminal bucket
+        assert bucket_sizes(6) == [1, 2, 4, 6]
+
+    def test_bucket_for(self):
+        sizes = bucket_sizes(8)
+        assert [bucket_for(n, sizes) for n in (1, 2, 3, 5, 8)] == \
+            [1, 2, 4, 8, 8]
+        assert bucket_for(9, sizes) is None
+
+    def test_jit_cache_is_bounded_by_buckets(self, snap_dir):
+        eng = ServeEngine(os.path.join(snap_dir, "snap"), max_batch=4)
+        eng.load()
+        for n in (1, 2, 3, 4, 1, 3, 2, 4):
+            eng.forward({"data": np.zeros((n, 8), np.float32)})
+        assert set(eng._fwd) <= set(eng.buckets)
+        assert len(eng._fwd) == 3           # buckets 1, 2, 4 touched
+
+
+class TestDeployNet:
+    def test_loss_and_label_feed_dropped(self):
+        dep = deploy_net_param(_mlp_net())
+        names = [lp.name for lp in dep.layer]
+        assert "loss" not in names
+        assert "l" not in names             # orphaned label feed pruned
+        assert "d" in names and "fc2" in [lp.name for lp in dep.layer]
+
+    def test_deploy_shaped_net_passes_through(self):
+        dep = deploy_net_param(_mlp_net())
+        again = deploy_net_param(dep)
+        assert [lp.name for lp in again.layer] == \
+            [lp.name for lp in dep.layer]
+
+
+# ---------------------------------------------------------- engine ----
+
+def _reference_logits(model_path, xs):
+    """Direct unpadded forward at exactly xs.shape[0] rows."""
+    import jax
+    from sparknet_tpu.proto import wire
+    from sparknet_tpu.graph.compiler import CompiledNet, TEST
+    blob = wire.load(model_path, "NetParameter")
+    dep = deploy_net_param(blob.copy())
+    net = CompiledNet(dep, TEST,
+                      feed_shapes={"data": (xs.shape[0], 8)})
+    params, state = net.init(jax.random.PRNGKey(0))
+    params, state = net.load_netproto(blob, params, state)
+    blobs, _ = net.apply(params, state, {"data": xs}, train=False)
+    return np.asarray(blobs["fc2"])
+
+
+class TestEngineParity:
+    def test_padded_logits_match_direct_forward(self, snap_dir):
+        """Acceptance: across every bucket, padded serving logits equal
+        a direct unpadded forward to fp32 roundoff."""
+        prefix = os.path.join(snap_dir, "snap")
+        eng = ServeEngine(prefix, max_batch=8)
+        entry = eng.load()
+        model_path = os.path.join(snap_dir, entry["model"])
+        rs = np.random.RandomState(3)
+        for n in (1, 2, 3, 4, 5, 8):
+            xs = rs.randn(n, 8).astype(np.float32)
+            out, bucket = eng.forward({"data": xs})
+            assert bucket == bucket_for(n, eng.buckets)
+            assert out["fc2"].shape == (n, 4)
+            np.testing.assert_allclose(
+                out["fc2"], _reference_logits(model_path, xs),
+                rtol=1e-5, atol=1e-6)
+
+    def test_oversize_batch_rejected(self, snap_dir):
+        eng = ServeEngine(os.path.join(snap_dir, "snap"), max_batch=2)
+        eng.load()
+        with pytest.raises(ValueError, match="max_batch"):
+            eng.forward({"data": np.zeros((3, 8), np.float32)})
+
+    def test_feed_shapes_are_per_sample(self, snap_dir):
+        eng = ServeEngine(os.path.join(snap_dir, "snap"), max_batch=2)
+        eng.load()
+        assert eng.feed_shapes() == {"data": (8,)}   # label feed pruned
+
+
+# ------------------------------------------------------- load_model_only ----
+
+class TestLoadModelOnly:
+    def test_loads_without_solverstate(self, snap_dir, tmp_path):
+        prefix = _copy_snap(snap_dir, tmp_path)
+        d = os.path.dirname(prefix)
+        for f in os.listdir(d):
+            if ".solverstate" in f:
+                os.remove(os.path.join(d, f))
+        path, entry = load_model_only(prefix)
+        assert os.path.exists(path)
+        assert entry["iter"] == 3
+        eng = ServeEngine(prefix)
+        eng.load()                       # weights-only: still servable
+        eng.forward({"data": np.zeros((1, 8), np.float32)})
+
+    def test_missing_manifest_names_it(self, tmp_path):
+        prefix = str(tmp_path / "nosuch")
+        with pytest.raises(ValueError) as ei:
+            load_model_only(prefix)
+        assert manifest_path(prefix) in str(ei.value)
+
+    def test_torn_manifest_names_it(self, snap_dir, tmp_path):
+        prefix = _copy_snap(snap_dir, tmp_path)
+        with open(manifest_path(prefix), "w") as f:
+            f.write('{"version": 1, "latest": {"it')   # torn mid-write
+        with pytest.raises(ValueError) as ei:
+            load_model_only(prefix)
+        assert manifest_path(prefix) in str(ei.value)
+
+    def test_corrupt_model_blob_rejected(self, snap_dir, tmp_path):
+        prefix = _copy_snap(snap_dir, tmp_path)
+        man = load_manifest(prefix)
+        blob = os.path.join(os.path.dirname(prefix),
+                            man["latest"]["model"])
+        with open(blob, "r+b") as f:     # flip bytes: sha256 must fail
+            f.seek(0)
+            f.write(b"\xde\xad\xbe\xef")
+        with pytest.raises(ValueError) as ei:
+            load_model_only(prefix)
+        assert manifest_path(prefix) in str(ei.value)
+        assert "sha256" in str(ei.value)
+
+    def test_falls_back_to_older_servable_snapshot(self, snap_dir,
+                                                   tmp_path):
+        prefix = _copy_snap(snap_dir, tmp_path)
+        # grow the same manifest: restore and snapshot 2 more iters
+        man = load_manifest(prefix)
+        d = os.path.dirname(prefix)
+        sp = Message("SolverParameter", base_lr=0.1, lr_policy="fixed",
+                     momentum=0.9, random_seed=7)
+        sv = Solver(sp, net_param=_mlp_net(), log_fn=None)
+        sv.restore(os.path.join(d, man["latest"]["state"]))
+        rs = np.random.RandomState(9)
+        for _ in range(2):
+            sv.train_step({"data": rs.randn(16, 8).astype(np.float32),
+                           "label": rs.randint(0, 4, 16).astype(np.int32)})
+        sv.snapshot(prefix)
+        man = load_manifest(prefix)
+        assert man["latest"]["iter"] == 5
+        # corrupt the newest blob: serving must fall back to iter 3
+        with open(os.path.join(d, man["latest"]["model"]), "r+b") as f:
+            f.seek(0)
+            f.write(b"\xde\xad\xbe\xef")
+        path, entry = load_model_only(prefix)
+        assert entry["iter"] == 3
+        assert os.path.exists(path)
+
+
+# ---------------------------------------------------------- batcher ----
+
+class TestBatcher:
+    def test_deadline_flushes_partial_batch(self):
+        b = Batcher(max_batch=8, max_wait_s=0.05, queue_limit=64)
+        b.submit({"data": np.zeros((1, 8))}, n=1)
+        t0 = time.perf_counter()
+        reqs, _wait = b.next_batch(timeout=1.0)
+        elapsed = time.perf_counter() - t0
+        assert len(reqs) == 1            # flushed alone at the deadline
+        assert elapsed < 0.8             # ... not at the full timeout
+
+    def test_full_bucket_dispatches_before_deadline(self):
+        b = Batcher(max_batch=4, max_wait_s=10.0, queue_limit=64)
+        for _ in range(4):
+            b.submit({"data": np.zeros((1, 8))}, n=1)
+        t0 = time.perf_counter()
+        reqs, _wait = b.next_batch(timeout=1.0)
+        assert len(reqs) == 4
+        assert time.perf_counter() - t0 < 1.0
+
+    def test_backpressure_rejects_over_limit(self):
+        sink = _Sink()
+        b = Batcher(max_batch=4, max_wait_s=0.01, queue_limit=2,
+                    metrics=sink)
+        b.submit({"x": [0]}, n=1)
+        b.submit({"x": [0]}, n=1)
+        with pytest.raises(RejectedError) as ei:
+            b.submit({"x": [0]}, n=1)
+        assert ei.value.reason == "queue_full"
+        assert ei.value.queue_depth == 2
+        assert [r["reason"] for r in sink.events("serve_reject")] == \
+            ["queue_full"]
+
+    def test_draining_rejects_new_work(self):
+        b = Batcher(max_batch=4, queue_limit=8)
+        b.submit({"x": [0]}, n=1)
+        b.close()
+        with pytest.raises(RejectedError) as ei:
+            b.submit({"x": [0]}, n=1)
+        assert ei.value.reason == "draining"
+        # queued work is still drainable after close
+        reqs, _ = b.next_batch(timeout=0.2)
+        assert len(reqs) == 1
+        assert b.pending() == 0
+
+
+class TestParseInputs:
+    FEEDS = {"data": (8,)}
+
+    def test_bare_list_is_first_feed(self):
+        arrays, n = _parse_inputs([[0.0] * 8, [1.0] * 8], self.FEEDS)
+        assert n == 2 and arrays["data"].shape == (2, 8)
+
+    def test_single_sample_gets_batch_dim(self):
+        arrays, n = _parse_inputs({"data": [0.0] * 8}, self.FEEDS)
+        assert n == 1 and arrays["data"].shape == (1, 8)
+
+    def test_shape_mismatch_rejected(self):
+        with pytest.raises(ValueError, match="per-sample shape"):
+            _parse_inputs({"data": [[0.0] * 7]}, self.FEEDS)
+
+    def test_unknown_feed_rejected(self):
+        with pytest.raises(ValueError, match="unknown feed"):
+            _parse_inputs({"bogus": [[0.0] * 8]}, self.FEEDS)
+
+
+# -------------------------------------------------------- hot reload ----
+
+class TestHotReload:
+    def test_reload_without_dropping_in_flight(self, snap_dir,
+                                               tmp_path):
+        prefix = _copy_snap(snap_dir, tmp_path)
+        eng = ServeEngine(prefix, max_batch=2, log_fn=None)
+        eng.load()
+        assert eng.status()["iter"] == 3
+        errors = []
+        stop = threading.Event()
+        xs = np.random.RandomState(0).randn(2, 8).astype(np.float32)
+
+        def hammer():
+            while not stop.is_set():
+                try:
+                    out, _ = eng.forward({"data": xs})
+                    assert out["fc2"].shape == (2, 4)
+                except Exception as e:      # surfaced on the main side
+                    errors.append(e)
+                    return
+
+        t = threading.Thread(target=hammer, daemon=True)
+        t.start()
+        try:
+            # training advances the SAME prefix to iter 5 while serving
+            d = os.path.dirname(prefix)
+            man = load_manifest(prefix)
+            sp = Message("SolverParameter", base_lr=0.1,
+                         lr_policy="fixed", momentum=0.9, random_seed=7)
+            sv = Solver(sp, net_param=_mlp_net(), log_fn=None)
+            sv.restore(os.path.join(d, man["latest"]["state"]))
+            rs = np.random.RandomState(5)
+            for _ in range(2):
+                sv.train_step(
+                    {"data": rs.randn(16, 8).astype(np.float32),
+                     "label": rs.randint(0, 4, 16).astype(np.int32)})
+            sv.snapshot(prefix)
+            entry = eng.poll_reload()
+            assert entry is not None and entry["iter"] == 5
+            assert eng.poll_reload() is None     # idempotent
+            out, _ = eng.forward({"data": xs})
+            assert out["fc2"].shape == (2, 4)
+        finally:
+            stop.set()
+            t.join(timeout=10)
+        assert errors == []
+        st = eng.status()
+        assert st["iter"] == 5 and st["reloads"] == 1
+
+    def test_torn_manifest_keeps_old_weights(self, snap_dir, tmp_path):
+        prefix = _copy_snap(snap_dir, tmp_path)
+        eng = ServeEngine(prefix, max_batch=2, log_fn=None)
+        eng.load()
+        before, _ = eng.forward(
+            {"data": np.ones((1, 8), np.float32)})
+        with open(manifest_path(prefix), "w") as f:
+            f.write('{"version": 1, "latest"')       # torn mid-swap
+        assert eng.poll_reload() is None
+        after, _ = eng.forward({"data": np.ones((1, 8), np.float32)})
+        np.testing.assert_array_equal(before["fc2"], after["fc2"])
+        assert eng.status()["iter"] == 3             # old entry kept
+
+
+# ----------------------------------------------------- process contract ----
+
+def _serve_env():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    return env
+
+
+def _start_server(prefix, metrics_path, max_batch=2):
+    p = subprocess.Popen(
+        [sys.executable, "-m", "sparknet_tpu", "serve",
+         "--prefix", prefix, "--port", "0", "--no_warmup",
+         "--max_batch", str(max_batch), "--metrics", metrics_path],
+        cwd=REPO, env=_serve_env(), text=True,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+    url, lines = None, []
+    deadline = time.time() + 120
+    while time.time() < deadline:
+        line = p.stdout.readline()
+        if not line:
+            break
+        lines.append(line)
+        m = re.search(r"listening on (http://\S+)", line)
+        if m:
+            url = m.group(1)
+            break
+    if url is None:
+        p.kill()
+        raise AssertionError("server never announced: " + "".join(lines))
+    # keep the pipe drained so the server never blocks on stdout
+    drain = threading.Thread(
+        target=lambda: lines.extend(iter(p.stdout.readline, "")),
+        daemon=True)
+    drain.start()
+    return p, url, lines
+
+
+def _predict(url, rows=1, timeout=30.0):
+    from urllib.request import urlopen, Request
+    body = json.dumps(
+        np.zeros((rows, 8)).tolist()).encode("utf-8")
+    req = Request(url + "/predict", data=body,
+                  headers={"Content-Type": "application/json"})
+    with urlopen(req, timeout=timeout) as r:
+        return r.status, json.loads(r.read())
+
+
+class TestProcessContract:
+    def test_sigterm_drains_and_exits_zero(self, snap_dir, tmp_path):
+        prefix = _copy_snap(snap_dir, tmp_path)
+        mfile = str(tmp_path / "serve.jsonl")
+        p, url, lines = _start_server(prefix, mfile)
+        try:
+            code, body = _predict(url, rows=2)
+            assert code == 200
+            assert np.asarray(body["outputs"]["fc2"]).shape == (2, 4)
+            assert body["iter"] == 3
+            p.send_signal(signal.SIGTERM)
+            rc = p.wait(timeout=60)
+        finally:
+            if p.poll() is None:
+                p.kill()
+                p.wait(timeout=30)
+        assert rc == 0
+        out = "".join(lines)
+        assert "drained cleanly" in out
+        rows = [json.loads(ln) for ln in open(mfile) if ln.strip()]
+        summaries = [r for r in rows if r.get("event") == "serve_summary"]
+        assert len(summaries) == 1 and summaries[0]["drained"] is True
+        assert summaries[0]["requests"] == 1
+
+    def test_unservable_checkpoint_exits_3(self, tmp_path):
+        p = subprocess.run(
+            [sys.executable, "-m", "sparknet_tpu", "serve",
+             "--prefix", str(tmp_path / "nothing"), "--port", "0"],
+            cwd=REPO, env=_serve_env(), text=True, timeout=120,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+        assert p.returncode == 3            # EXIT_RECOVERY_ABORT
+        assert "manifest" in p.stdout
+
+    def test_sigkill_under_load_leaves_no_partial_state(self, snap_dir,
+                                                        tmp_path):
+        prefix = _copy_snap(snap_dir, tmp_path)
+        mfile = str(tmp_path / "serve.jsonl")
+        p, url, _lines = _start_server(prefix, mfile)
+        stop = threading.Event()
+
+        def fire():
+            while not stop.is_set():
+                try:
+                    _predict(url, rows=1, timeout=5.0)
+                except Exception:
+                    return                   # server died mid-request
+        threads = [threading.Thread(target=fire, daemon=True)
+                   for _ in range(3)]
+        for t in threads:
+            t.start()
+        try:
+            time.sleep(0.5)                  # requests in flight
+            p.kill()                         # SIGKILL: no drain
+            p.wait(timeout=30)
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(timeout=10)
+        # serving only reads the checkpoint dir: manifest and blobs
+        # stay valid, no temp files, and a fresh engine serves
+        d = os.path.dirname(prefix)
+        assert not [f for f in os.listdir(d) if ".tmp." in f]
+        assert load_manifest(prefix)["latest"]["iter"] == 3
+        eng = ServeEngine(prefix, log_fn=None)
+        eng.load()
+        out, _ = eng.forward({"data": np.zeros((1, 8), np.float32)})
+        assert out["fc2"].shape == (1, 4)
