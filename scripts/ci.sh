@@ -26,6 +26,13 @@ echo "multihost smoke OK"
 bash scripts/smoke.sh async || exit 1
 echo "async smoke OK"
 
+# elastic world resizing, end to end: a 2-process world's checkpoint
+# resumes at N-1 and N+1 under --reshard auto (strict refusal names
+# the remedy), a preempted host rejoins through the rendezvous, and a
+# live run admits a late-started --grow host with zero recompiles
+bash scripts/smoke.sh resize || exit 1
+echo "resize smoke OK"
+
 # serving tier, end to end: serve a snapshot, bench it across a live
 # hot reload with zero rejects/errors, drain on SIGTERM with exit 0,
 # and render the serving section (scripts/smoke.sh stage i)

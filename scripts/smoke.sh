@@ -310,6 +310,203 @@ assert h['iter'] == 5, h"
          "SIGTERM drained with exit 0, report rendered the section"
 }
 
+# --------------------------------------- elastic world resizing ----
+# (j) cross-world checkpoint resharding + grow-mid-run (ISSUE 12):
+#     a 2-process run writes world-stamped snapshots and loses host 1
+#     to chaos SIGKILL; the survivor completes. A single-process
+#     relaunch under --reshard strict is REFUSED with the actionable
+#     WorldMismatch; --reshard auto resumes the same checkpoint at
+#     N-1 (1 process) and N+1 (3 processes). Finally a live 2-process
+#     run ADMITS a late-started --grow host through the heartbeat
+#     rendezvous with zero recompiles, and `sparknet report` renders
+#     the eviction, the reshard, and the join.
+run_resize_stage() {
+    rz="$tmp/resize"
+    mkdir -p "$rz"
+    # shared persistent compile cache: the joiner replays the
+    # incumbents' XLA executables instead of re-tracing for minutes
+    export JAX_COMPILATION_CACHE_DIR="$rz/jaxcache"
+
+    # virtual preempt/rejoin cycle (chaos grammar satellite): the
+    # preempted host drops its lease, is evicted, and is ADMITTED back
+    # through the rendezvous rejoin_after rounds later
+    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python -m sparknet_tpu cifar --workers 4 --hosts 2 --tau 1 \
+        --rounds 6 --test-every 100 --metrics "$rz/pre.jsonl" \
+        --chaos "preempt_host=1,preempt_round=2,rejoin_after=2" \
+        --quorum 1 --evict-after 1 --readmit-after 0 \
+        > "$rz/pre.out" 2>&1
+    grep -q "EVICTED host 1" "$rz/pre.out"
+    grep -q "ADMITTED host 1" "$rz/pre.out"
+    python -m sparknet_tpu report "$rz/pre.jsonl" | tee "$rz/pre.rep" \
+        > /dev/null
+    grep -q "joined host 1" "$rz/pre.rep"
+
+    # 2-process training fleet: world-stamped snapshots every 2 rounds;
+    # chaos SIGKILLs host 1 at round 3, the survivor finishes all 6
+    port=$(python -c "import socket; s=socket.socket(); \
+s.bind(('localhost',0)); print(s.getsockname()[1])")
+    pids=()
+    for i in 0 1; do
+        SPARKNET_COORDINATOR="localhost:$port" \
+        SPARKNET_NUM_PROCESSES=2 SPARKNET_PROCESS_ID=$i \
+        SPARKNET_CHAOS="kill_host=1,kill_host_round=3" \
+        XLA_FLAGS="--xla_force_host_platform_device_count=4" \
+        python -m sparknet_tpu cifar --workers 4 --hosts 2 --tau 2 \
+            --rounds 6 --test-every 100 --metrics "$rz/w2-$i.jsonl" \
+            --snapshot-prefix "$rz/snap" --snapshot-every 2 \
+            --heartbeat-dir "$rz/rdv1" --lease-s 1.5 \
+            --heartbeat-interval 0.2 \
+            --quorum 1 --evict-after 1 --readmit-after 0 \
+            > "$rz/w2-$i.out" 2>&1 &
+        pids+=($!)
+    done
+    rc0=0; wait "${pids[0]}" || rc0=$?
+    rc1=0; wait "${pids[1]}" || rc1=$?
+    test "$rc0" -eq 0 || { echo "resize: survivor failed (rc=$rc0):"
+                           cat "$rz/w2-0.out"; exit 1; }
+    test "$rc1" -ne 0 || { echo "resize: chaos target was supposed to die"
+                           exit 1; }
+    grep -q "EVICTED host 1" "$rz/w2-0.out"
+    python - "$rz" <<'EOF'
+from sparknet_tpu.resilience import checkpoint
+import sys
+man = checkpoint.load_manifest(sys.argv[1] + "/snap")
+w = man["latest"]["world"]
+assert w["processes"] == 2, f"snapshot not stamped 2-process: {w}"
+print(f"resize: snapshot stamped for world {w}")
+EOF
+
+    # strict refusal: the single-process relaunch must name both
+    # worlds and the exact remedy, and exit nonzero
+    rc=0
+    XLA_FLAGS="--xla_force_host_platform_device_count=4" \
+    python -m sparknet_tpu cifar --workers 4 --hosts 1 --tau 2 \
+        --rounds 2 --test-every 100 \
+        --snapshot-prefix "$rz/snap" --resume auto --reshard strict \
+        > "$rz/strict.out" 2>&1 || rc=$?
+    test "$rc" -ne 0 || { echo "resize: strict resume was supposed to"\
+                               "refuse the 2-process snapshot"
+                          cat "$rz/strict.out"; exit 1; }
+    grep -q "different world" "$rz/strict.out"
+    grep -qe "--reshard auto" "$rz/strict.out"
+
+    # N-1: the 2-process world's checkpoint resumes on ONE process
+    XLA_FLAGS="--xla_force_host_platform_device_count=4" \
+    python -m sparknet_tpu cifar --workers 4 --hosts 1 --tau 2 \
+        --rounds 2 --test-every 100 --metrics "$rz/w1.jsonl" \
+        --snapshot-prefix "$rz/snap" --resume auto --reshard auto \
+        > "$rz/w1.out" 2>&1 || { echo "resize: N-1 resume failed:"
+                                 cat "$rz/w1.out"; exit 1; }
+    grep -q "reshard: snapshot" "$rz/w1.out"
+    grep -qE "round 1: loss = [0-9.]+" "$rz/w1.out"
+    python -m sparknet_tpu report "$rz/w1.jsonl" | tee "$rz/w1.rep" \
+        > /dev/null
+    grep -q "resharded snapshot for this world" "$rz/w1.rep"
+
+    # N+1: the same checkpoint resumes on THREE processes. Generous
+    # lease + no eviction pressure: this phase tests the reshard
+    # resume, and round-0 compile skew between the processes must not
+    # read as death (a spuriously-dead peer skips the jax.distributed
+    # shutdown barrier and aborts the survivor)
+    port=$(python -c "import socket; s=socket.socket(); \
+s.bind(('localhost',0)); print(s.getsockname()[1])")
+    pids=()
+    for i in 0 1 2; do
+        SPARKNET_COORDINATOR="localhost:$port" \
+        SPARKNET_NUM_PROCESSES=3 SPARKNET_PROCESS_ID=$i \
+        XLA_FLAGS="--xla_force_host_platform_device_count=4" \
+        python -m sparknet_tpu cifar --workers 4 --hosts 3 --tau 2 \
+            --rounds 2 --test-every 100 --metrics "$rz/w3-$i.jsonl" \
+            --snapshot-prefix "$rz/snap" --resume auto --reshard auto \
+            --heartbeat-dir "$rz/rdv3" --lease-s 6 \
+            --heartbeat-interval 0.2 --quorum 1 --evict-after 999 \
+            > "$rz/w3-$i.out" 2>&1 &
+        pids+=($!)
+    done
+    for i in 0 1 2; do
+        rc=0; wait "${pids[$i]}" || rc=$?
+        test "$rc" -eq 0 || { echo "resize: N+1 process $i failed"\
+                                   "(rc=$rc):"; cat "$rz/w3-$i.out"
+                              exit 1; }
+    done
+    grep -q "reshard: snapshot" "$rz/w3-0.out"
+
+    # grow-mid-run: 2 incumbents train; a LATE-STARTED third process
+    # leases itself into the rendezvous with --grow and is admitted
+    # with zero recompiles
+    port=$(python -c "import socket; s=socket.socket(); \
+s.bind(('localhost',0)); print(s.getsockname()[1])")
+    pids=()
+    for i in 0 1; do
+        SPARKNET_COORDINATOR="localhost:$port" \
+        SPARKNET_NUM_PROCESSES=2 SPARKNET_PROCESS_ID=$i \
+        XLA_FLAGS="--xla_force_host_platform_device_count=4" \
+        python -m sparknet_tpu cifar --workers 4 --hosts 2 --tau 1 \
+            --rounds 40 --test-every 100 --metrics "$rz/g-$i.jsonl" \
+            --snapshot-prefix "$rz/gsnap" --snapshot-every 3 \
+            --heartbeat-dir "$rz/grdv" --lease-s 6 \
+            --heartbeat-interval 0.2 \
+            --quorum 1 --evict-after 999 --readmit-after 0 \
+            > "$rz/g-$i.out" 2>&1 &
+        pids+=($!)
+    done
+    # the joiner bootstraps its weights from the fleet's snapshots:
+    # wait for the first manifest commit before launching it
+    python - "$rz" <<'EOF'
+from sparknet_tpu.resilience import checkpoint
+import sys
+entry = checkpoint.wait_for_manifest(sys.argv[1] + "/gsnap", timeout=240)
+assert entry is not None, "incumbents never committed a snapshot"
+print(f"resize: fleet snapshot at iter {entry['iter']}; growing")
+EOF
+    XLA_FLAGS="--xla_force_host_platform_device_count=4" \
+    python -m sparknet_tpu cifar --workers 4 --hosts 1 --tau 1 \
+        --rounds 3 --test-every 100 --metrics "$rz/g-join.jsonl" \
+        --snapshot-prefix "$rz/gsnap" --resume auto --reshard auto \
+        --grow --heartbeat-dir "$rz/grdv" --lease-s 6 \
+        --heartbeat-interval 0.2 --quorum 1 --evict-after 999 \
+        > "$rz/g-join.out" 2>&1 &
+    jpid=$!
+    rc0=0; wait "${pids[0]}" || rc0=$?
+    rc1=0; wait "${pids[1]}" || rc1=$?
+    rcj=0; wait "$jpid" || rcj=$?
+    test "$rc0" -eq 0 || { echo "resize: grow incumbent 0 failed"\
+                                "(rc=$rc0):"; cat "$rz/g-0.out"; exit 1; }
+    test "$rc1" -eq 0 || { echo "resize: grow incumbent 1 failed"\
+                                "(rc=$rc1):"; cat "$rz/g-1.out"; exit 1; }
+    test "$rcj" -eq 0 || { echo "resize: joiner failed (rc=$rcj):"
+                           cat "$rz/g-join.out"; exit 1; }
+    grep -q "joining a running world of 2 host(s) \[0, 1\] as host 2" \
+        "$rz/g-join.out"
+    grep -q "host 2 joined the rendezvous" "$rz/g-0.out"
+    grep -q "ADMITTED host 2" "$rz/g-0.out"
+    python - "$rz" <<'EOF'
+import json, sys
+evs = [json.loads(l) for l in open(sys.argv[1] + "/g-0.jsonl")]
+hj = [e for e in evs if e.get("event") == "host_joined"]
+assert hj, "no host_joined event in the incumbent's stream"
+t_join = hj[0]["t"]
+recompiles = [e for e in evs if e.get("event") == "recompile"
+              and not e.get("first") and e["t"] > t_join]
+assert not recompiles, f"admission recompiled: {recompiles}"
+print(f"resize: host {hj[0]['host']} admitted at round "
+      f"{hj[0]['round']} with zero recompiles")
+EOF
+    python -m sparknet_tpu report "$rz/g-0.jsonl" | tee "$rz/g.rep" \
+        > /dev/null
+    grep -q "joined host 2" "$rz/g.rep"
+    echo "resize stage OK: 2-process checkpoint resumed at N-1 and" \
+         "N+1 under --reshard auto, strict refusal names the remedy," \
+         "and a live run admitted a late --grow host with zero" \
+         "recompiles"
+}
+
+if [ "$stage" = "resize" ]; then
+    run_resize_stage
+    echo "SMOKE OK (resize)"
+    exit 0
+fi
 if [ "$stage" = "serve" ]; then
     run_serve_stage
     echo "SMOKE OK (serve)"

@@ -230,9 +230,28 @@ class CifarApp:
                 yield {"data": imgs, "label": labs}
 
     # -- the driver loop (CifarApp.scala:92-135) ---------------------------
-    def run(self, num_rounds=100, test_every=10, stall_seconds=600.0):
+    def run(self, num_rounds=100, test_every=10, stall_seconds=600.0,
+            snapshot_prefix=None, snapshot_every=0, resume=None,
+            reshard="strict"):
+        """``snapshot_prefix``/``snapshot_every``/``resume``/``reshard``
+        mirror LocalSGDSolver.run: in a multi-process world only the
+        designated writer commits (Solver._snapshot handles that), and
+        resume="auto" with reshard="auto" is how a late `--grow` joiner
+        bootstraps its weights from the running world's checkpoint
+        (the manifest is stamped for the incumbents' world, so a
+        cross-world reshard is exactly what the joiner needs)."""
         from ..data.prefetch import PrefetchIterator
         from ..utils.watchdog import Watchdog
+        from ..resilience import checkpoint
+
+        if resume == "auto":
+            if snapshot_prefix:
+                checkpoint.resume_auto(self.solver, snapshot_prefix,
+                                       log_fn=self.log, reshard=reshard)
+            else:
+                self.log("resume auto: no snapshot prefix; starting fresh")
+        elif resume:
+            self.solver.restore(resume, reshard=reshard)
 
         metrics = self.metrics
         steps_per_round = self.solver.tau \
@@ -278,6 +297,9 @@ class CifarApp:
                                         self.solver.iter)),
                                     images_per_s=round(imgs_per_round
                                                        / max(dt, 1e-9), 1))
+                    if snapshot_prefix and snapshot_every and \
+                            (r + 1) % snapshot_every == 0:
+                        self.solver.snapshot(prefix=snapshot_prefix)
         finally:
             batches.close()
             el = getattr(self.solver, "elastic", None)

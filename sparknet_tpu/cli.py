@@ -204,8 +204,9 @@ def cmd_train(args):
     hb = solver.heartbeat                    # close() drops the reference
     if args.weights:
         solver.load_weights(args.weights)
+    reshard = getattr(args, "reshard", "strict")
     if args.snapshot:
-        solver.restore(args.snapshot)
+        solver.restore(args.snapshot, reshard=reshard)
     if args.resume:
         from .resilience import checkpoint
         if args.resume == "auto":
@@ -213,9 +214,10 @@ def cmd_train(args):
                 raise SystemExit("--resume auto needs a snapshot prefix "
                                  "(--snapshot-prefix or the solver's "
                                  "snapshot_prefix)")
-            checkpoint.resume_auto(solver, prefix, log_fn=print)
+            checkpoint.resume_auto(solver, prefix, log_fn=print,
+                                   reshard=reshard)
         else:
-            solver.restore(args.resume)
+            solver.restore(args.resume, reshard=reshard)
     total = args.iterations or int(sp.max_iter) or 1000
     # device_put in the prefetch WORKER thread: the blocking host->HBM copy
     # of batch k+1 overlaps step k on the device (the H2D/compute overlap
@@ -511,9 +513,22 @@ def cmd_cifar(args):
     from .parallel.multihost import exit_if_peers_died
     rc = 0
     try:
-        app.run(num_rounds=args.rounds, test_every=args.test_every)
+        app.run(num_rounds=args.rounds, test_every=args.test_every,
+                snapshot_prefix=args.snapshot_prefix,
+                snapshot_every=args.snapshot_every,
+                resume=args.resume, reshard=args.reshard)
     except QuorumLost as e:
         print(f"QUORUM LOST: {e}")
+        # keep the healthy consensus for the supervisor relaunch, and
+        # barrier every survivor on the same manifest (same contract as
+        # `sparknet train`)
+        if args.snapshot_prefix:
+            try:
+                app.solver.snapshot(prefix=args.snapshot_prefix)
+                app.solver.coordinated_restart(args.snapshot_prefix)
+            except Exception as snap_err:
+                print(f"QUORUM LOST: best-effort snapshot failed "
+                      f"({snap_err})")
         rc = EXIT_QUORUM_LOST
     # a run that SURVIVED a peer-host death must report ITS exit code,
     # not die in the unreachable jax.distributed shutdown barrier
@@ -862,6 +877,14 @@ def _add_heartbeat_flags(p):
     p.add_argument("--heartbeat-interval", type=float, default=0.5,
                    help="seconds between heartbeat re-leases (must be "
                         "well under --lease-s)")
+    p.add_argument("--grow", action="store_true",
+                   help="late-join an already-RUNNING world through "
+                        "--heartbeat-dir: this standalone process scans "
+                        "the fresh leases, takes the next host id, and "
+                        "is admitted at the incumbents' next round gate "
+                        "(zero recompiles); pair with --resume auto "
+                        "--reshard auto to bootstrap weights from the "
+                        "running world's checkpoint")
 
 
 def _apply_heartbeat_flags(solver, args):
@@ -870,7 +893,8 @@ def _apply_heartbeat_flags(solver, args):
         return
     solver.arm_heartbeat(args.heartbeat_dir,
                          interval_s=args.heartbeat_interval,
-                         lease_s=args.lease_s)
+                         lease_s=args.lease_s,
+                         grow=getattr(args, "grow", False))
 
 
 def _add_elastic_flags(p):
@@ -1058,6 +1082,14 @@ def main(argv=None):
                         "under the snapshot prefix (partial/corrupt ones "
                         "are skipped with a reason); or an explicit "
                         ".solverstate[.h5] path")
+    t.add_argument("--reshard", choices=("strict", "auto"),
+                   default="strict",
+                   help="cross-world restore policy: 'strict' refuses a "
+                        "snapshot stamped by a different world "
+                        "(WorldMismatch names both worlds); 'auto' "
+                        "re-partitions it for THIS world — an 8-way "
+                        "run's checkpoint resumes on 4 or 16 "
+                        "(resilience/checkpoint.reshard_for_world)")
     t.add_argument("--keep", type=int, default=5,
                    help="snapshot retention: keep the newest N manifested "
                         "snapshots, delete older ones (0 = keep all)")
@@ -1190,6 +1222,22 @@ def main(argv=None):
                    help="test every N rounds (CifarApp.scala:98)")
     c.add_argument("--log")
     c.add_argument("--metrics", help="JSONL metrics output path")
+    c.add_argument("--snapshot-prefix",
+                   help="write periodic snapshots under this prefix "
+                        "(enables --resume auto and the QuorumLost "
+                        "best-effort snapshot)")
+    c.add_argument("--snapshot-every", type=int, default=0,
+                   help="snapshot every N rounds (0 disables)")
+    c.add_argument("--resume", metavar="auto|STATE",
+                   help="'auto': continue from the newest valid snapshot "
+                        "under --snapshot-prefix; or an explicit "
+                        ".solverstate[.h5] path")
+    c.add_argument("--reshard", choices=("strict", "auto"),
+                   default="strict",
+                   help="cross-world restore policy: 'auto' re-partitions "
+                        "a snapshot stamped by a different world for THIS "
+                        "world (8-way checkpoint resumes on 4 or 16); "
+                        "'strict' refuses with WorldMismatch")
     c.add_argument("--chaos", metavar="SPEC",
                    help="deterministic fault injection (e.g. "
                         "'stall_step=10,stall_s=2,stall_worker=1' to "

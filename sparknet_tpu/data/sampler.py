@@ -80,3 +80,38 @@ def partition_owners(num_partitions, alive):
             owners[p] = live[j % len(live)]
             j += 1
     return owners
+
+
+def reshard_owners(n_from, n_to):
+    """Cross-world re-partitioning rule (resilience/checkpoint.py's
+    reshard_for_world): carry ``n_from`` data partitions written by
+    world W1 onto world W2's ``n_to`` slots, reusing partition_owners
+    in both directions so resharding and eviction follow ONE rule.
+
+    Shrink (n_to < n_from): W1's world with the trailing slots dead —
+    owners[p] is the W2 slot that inherits W1 partition p, so every
+    old partition stays owned:
+
+    >>> reshard_owners(4, 2)
+    array([0, 1, 0, 1])
+
+    Grow (n_to > n_from): W2's world where only the first n_from slots
+    arrive with data — owners[s] is the W1 partition new slot s
+    bootstraps from:
+
+    >>> reshard_owners(2, 4)
+    array([0, 1, 0, 1])
+
+    Same size is the identity (bit-for-bit restore, no re-spread). The
+    returned array always has max(n_from, n_to) entries — the larger
+    world's slot count.
+    """
+    a, b = int(n_from), int(n_to)
+    if a <= 0 or b <= 0:
+        raise ValueError(f"worlds need at least one slot "
+                         f"(got {n_from} -> {n_to})")
+    n = max(a, b)
+    k = min(a, b)
+    alive = np.zeros(n, bool)
+    alive[:k] = True
+    return partition_owners(n, alive)

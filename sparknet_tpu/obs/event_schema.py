@@ -75,6 +75,10 @@ EVENTS = {
         "fields": ['host', 'live', 'reason', 'round'],
         "open": False,
     },
+    'host_joined': {
+        "fields": ['host', 'live', 'round', 'via', 'world'],
+        "open": False,
+    },
     'host_round': {
         "fields": ['arrived', 'dead', 'lease_age_s', 'observer', 'round', 'wait_s'],
         "open": False,
@@ -109,6 +113,10 @@ EVENTS = {
     },
     'recovery': {
         "fields": ['attempt', 'iter', 'kind', 'loss', 'lr_decay', 'reason', 'rollbacks', 'to_iter'],
+        "open": False,
+    },
+    'reshard': {
+        "fields": ['direction', 'from_world', 'iter', 'n_from', 'n_to', 'owners', 'state', 'to_world'],
         "open": False,
     },
     'retry': {
@@ -177,6 +185,6 @@ EVENTS = {
     },
 }
 
-KINDS = ['abort', 'coordinated_restart', 'killed', 'mesh_shrunk', 'nan', 'params', 'quorum_lost', 'recovery_armed', 'resume', 'rollback', 'serve', 'stall', 'summary', 'world_reset']
+KINDS = ['abort', 'admission', 'coordinated_restart', 'killed', 'mesh_shrunk', 'nan', 'params', 'quorum_lost', 'recovery_armed', 'resume', 'rollback', 'serve', 'stall', 'summary', 'world_reset']
 
 KINDS_OPEN = True

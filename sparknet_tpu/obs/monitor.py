@@ -73,7 +73,11 @@ class MonitorState:
         self.host_lease_age = None  # last per-host lease-age vector
         self.host_gate = None       # last host_round event
         self.host_evictions = collections.Counter()
+        self.host_joins = collections.Counter()
+        self.last_host_join = None
         self.coordinated_restart = None
+        # elastic world resizing (resilience/checkpoint.py reshard)
+        self.reshard = None         # last reshard event, if any
         # serving tier (serve/server.py, ISSUE 11)
         self.serve_requests = 0
         self.serve_rows = 0
@@ -179,6 +183,13 @@ class MonitorState:
         elif kind == "host_evicted":
             if ev.get("host") is not None:
                 self.host_evictions[int(ev["host"])] += 1
+        elif kind == "host_joined":
+            if ev.get("host") is not None:
+                self.host_joins[int(ev["host"])] += 1
+                self.host_alive[int(ev["host"])] = True
+            self.last_host_join = ev
+        elif kind == "reshard":
+            self.reshard = ev
         elif kind == "serve_request":
             self.serve_requests += 1
             if _num(ev.get("rows")):
@@ -297,7 +308,8 @@ class MonitorState:
                 L.append(f"    last park: {p.get('unit', 'worker')} "
                          f"{p.get('worker')} round {p.get('round')} "
                          f"(lag {p.get('lag')})")
-        if self.host_alive or self.host_gate or self.host_evictions:
+        if (self.host_alive or self.host_gate or self.host_evictions
+                or self.host_joins):
             bits = []
             if self.host_alive:
                 down = sorted(h for h, a in self.host_alive.items() if not a)
@@ -306,10 +318,18 @@ class MonitorState:
             if self.host_evictions:
                 bits.append("evicted " + ", ".join(
                     f"h{h}:{c}" for h, c in self.host_evictions.most_common()))
+            if self.host_joins:
+                bits.append("joined " + ", ".join(
+                    f"h{h}" for h in sorted(self.host_joins)))
             if self.host_gate and _num(self.host_gate.get("wait_s")):
                 bits.append(f"gate wait {self.host_gate['wait_s']:.3f}s "
                             f"@r{self.host_gate.get('round')}")
             L.append("  hosts: " + "  ".join(bits))
+            if self.last_host_join is not None:
+                j = self.last_host_join
+                L.append(f"    last join: host {j.get('host')} at round "
+                         f"{j.get('round')} ({j.get('via')}, world -> "
+                         f"{j.get('world')})")
             if self.host_lease_age:
                 L.append("    lease ages: " + " ".join(
                     f"{a:.2f}s" for a in self.host_lease_age))
@@ -386,6 +406,11 @@ class MonitorState:
             extras.append(f"chaos injections {self.chaos}")
         if self.checkpoint_iter is not None:
             extras.append(f"last checkpoint iter {self.checkpoint_iter}")
+        if self.reshard is not None:
+            extras.append(
+                f"resharded ({self.reshard.get('direction')}) "
+                f"{self.reshard.get('n_from')} -> "
+                f"{self.reshard.get('n_to')} slots")
         if extras:
             L.append("  " + "  ".join(extras))
         if self.alarms:

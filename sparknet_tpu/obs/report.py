@@ -179,8 +179,15 @@ def aggregate(events):
     ev = [e for e in events if e.get("event") == "eviction"]
     rd = [e for e in events if e.get("event") == "readmission"]
     mem = [e for e in events if e.get("event") == "membership"]
+    adm = [e for e in mem if e.get("kind") == "admission"]
     if ev or rd or mem:
         el = {"evictions": len(ev), "readmissions": len(rd)}
+        if adm:
+            el["admissions"] = len(adm)
+            el["admission_records"] = [
+                {"worker": e.get("worker"), "round": e.get("round"),
+                 "via": e.get("via"),
+                 "unit": e.get("unit", "worker")} for e in adm][:20]
         if ev:
             el["evictions_by_worker"] = {
                 str(k): v for k, v in collections.Counter(
@@ -208,8 +215,9 @@ def aggregate(events):
     ha = [e for e in events if e.get("event") == "host_alive"]
     hr = [e for e in events if e.get("event") == "host_round"]
     he = [e for e in events if e.get("event") == "host_evicted"]
+    hj = [e for e in events if e.get("event") == "host_joined"]
     cr = [e for e in mem if e.get("kind") == "coordinated_restart"]
-    if ha or hr or he or cr:
+    if ha or hr or he or hj or cr:
         mh = {}
         if ha:
             last = {}
@@ -236,6 +244,11 @@ def aggregate(events):
             mh["host_evictions"] = [
                 {"host": e.get("host"), "round": e.get("round"),
                  "reason": e.get("reason")} for e in he][:20]
+        if hj:
+            mh["host_joins"] = [
+                {"host": e.get("host"), "round": e.get("round"),
+                 "via": e.get("via"), "world": e.get("world")}
+                for e in hj][:20]
         if cr:
             last = cr[-1]
             mh["coordinated_restart"] = {
@@ -296,6 +309,15 @@ def aggregate(events):
             c["resumed_from_iter"] = resumes[-1].get("iter")
             c["resume_refused"] = resumes[-1].get("refused")
         rep["checkpoints"] = c
+    rs = [e for e in events if e.get("event") == "reshard"]
+    if rs:
+        last = rs[-1]
+        rep.setdefault("checkpoints", {})["reshard"] = {
+            "count": len(rs),
+            "from_world": last.get("from_world"),
+            "to_world": last.get("to_world"),
+            "direction": last.get("direction"),
+            "iter": last.get("iter")}
 
     # -- training health (obs divergence/health/memstats) ------------------
     div = [e for e in events if e.get("event") == "divergence"]
@@ -567,6 +589,12 @@ def render(rep):
                 if cp.get("resume_refused"):
                     line += f" ({cp['resume_refused']} snapshot(s) refused)"
                 L.append(line)
+            if cp.get("reshard"):
+                rsh = cp["reshard"]
+                L.append(f"  resharded snapshot for this world "
+                         f"({rsh.get('direction')}): "
+                         f"{rsh.get('from_world')} -> "
+                         f"{rsh.get('to_world')}")
         r = rep.get("recovery")
         if r:
             L.append("  recovery: " + ", ".join(
@@ -587,6 +615,8 @@ def render(rep):
             line = f"  elastic membership: {el.get('evictions', 0)} " \
                    f"eviction(s), {el.get('readmissions', 0)} " \
                    "readmission(s)"
+            if el.get("admissions"):
+                line += f", {el['admissions']} admission(s)"
             if _num(el.get("min_live")):
                 line += f", live dipped to {el['min_live']}"
             L.append(line)
@@ -594,6 +624,10 @@ def render(rep):
                 L.append(f"    evicted {r.get('unit', 'worker')} "
                          f"{r.get('worker')} at round "
                          f"{r.get('round')}: {r.get('reason')}")
+            for r in el.get("admission_records", [])[:10]:
+                L.append(f"    admitted {r.get('unit', 'worker')} "
+                         f"{r.get('worker')} at round "
+                         f"{r.get('round')} ({r.get('via')})")
             if el.get("mesh_shrunk"):
                 L.append(f"    mesh shrunk {el['mesh_shrunk'].get('from')}"
                          f" -> {el['mesh_shrunk'].get('to')} workers")
@@ -652,6 +686,10 @@ def render(rep):
         for r in mh.get("host_evictions", [])[:10]:
             L.append(f"  evicted host {r.get('host')} at round "
                      f"{r.get('round')}: {r.get('reason')}")
+        for r in mh.get("host_joins", [])[:10]:
+            L.append(f"  joined host {r.get('host')} at round "
+                     f"{r.get('round')} ({r.get('via')}, world -> "
+                     f"{r.get('world')})")
         cr = mh.get("coordinated_restart")
         if cr:
             L.append(f"  coordinated restart: "

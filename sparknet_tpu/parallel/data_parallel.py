@@ -888,6 +888,26 @@ class LocalSGDSolver(Solver):
         exchange instead."""
         from ..resilience.elastic import QuorumLost
         hb = self.heartbeat
+        if getattr(self, "_grow_pending", False):
+            # late joiner (--grow): fast-forward to the running world's
+            # front before the first gate — incumbents' gates accept
+            # any arrival at round >= theirs, so the no-hang contract
+            # holds from the joiner's very first rendezvous
+            self._grow_pending = False
+            front = hb.peer_round_max()
+            if front >= 0:
+                self.log(f"grow: fast-forwarding from round "
+                         f"{self._round_idx} to the running world's "
+                         f"front (round {front + 1})")
+                self._round_idx = front + 1
+        if self._relay is not None and self.elastic is not None:
+            # grow-mid-run: a fresh out-of-world lease is a late-started
+            # --grow process asking in. Admission is pure host-side
+            # bookkeeping (the alive mask and the view arrays extend),
+            # so the compiled round never recompiles.
+            for j in hb.poll_joiners():
+                if hb.admit_host(j):
+                    self.elastic.admit(j, self._round_idx, via="grow")
         if self.elastic is not None and self.elastic.n == hb.n:
             expect = set(self.elastic.live())
         else:
@@ -1068,6 +1088,7 @@ class LocalSGDSolver(Solver):
 
     def run(self, num_rounds, batch_fn, test_data_fn=None, test_every=10,
             snapshot_prefix=None, snapshot_every=0, resume=None,
+            reshard="strict",
             sigint="stop", sighup="snapshot", sigterm="snapshot_stop"):
         """The reference driver loop (CifarApp.scala:92-135): for each round,
         optionally test (every ``test_every`` rounds, :98), then train tau
@@ -1076,7 +1097,11 @@ class LocalSGDSolver(Solver):
         Fault tolerance (the opposite of the reference's
         spark.task.maxFailures=1 contract):
           * resume="auto" restores the newest valid snapshot under the
-            prefix before the first round (a path restores that snapshot)
+            prefix before the first round (a path restores that
+            snapshot); reshard="auto" additionally accepts a snapshot
+            stamped by a DIFFERENT world and re-partitions it for this
+            one (resilience/checkpoint.reshard_for_world) instead of
+            refusing with WorldMismatch
           * signals are polled BETWEEN rounds: SIGHUP snapshots, SIGINT
             stops cleanly, SIGTERM (a preemption notice) snapshots then
             stops — pair with `--resume auto` on relaunch
@@ -1099,11 +1124,12 @@ class LocalSGDSolver(Solver):
                                      else None)
         if resume == "auto":
             if prefix:
-                checkpoint.resume_auto(self, prefix, log_fn=self.log)
+                checkpoint.resume_auto(self, prefix, log_fn=self.log,
+                                       reshard=reshard)
             else:
                 self.log("resume auto: no snapshot prefix; starting fresh")
         elif resume:
-            self.restore(resume)
+            self.restore(resume, reshard=reshard)
         r = 0
         with SignalPolicy(sigint=sigint, sighup=sighup,
                           sigterm=sigterm) as policy:

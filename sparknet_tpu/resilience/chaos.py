@@ -34,6 +34,16 @@ subsystem claims to survive — on a schedule tests can replay exactly:
                    single-process host meshes the host is marked dead
                    like kill_worker. Exercises host eviction, the
                    no-hang gate, and coordinated restart.
+  preempt_host=H, preempt_round=R, rejoin_after=K   host H is
+                   PREEMPTED at round R (default 0) and rejoins K
+                   rounds later through the rendezvous — the spot-fleet
+                   cycle. In a real multi-process run the targeted
+                   process SIGKILLs itself at the round gate (lease
+                   drop; the orchestration layer relaunches it with
+                   `--grow`, a real rejoin); in virtual single-process
+                   host meshes the host is evicted like kill_host and
+                   then ADMITTED back K rounds later
+                   (ElasticPolicy.admit — a host_joined event).
   partition_host=H, partition_round=R   from round R, host H and the
                    rest of the fleet stop seeing each other's
                    heartbeats (both sides of the split independently
@@ -106,6 +116,7 @@ class ChaosMonkey:
                  stall_repeat=False, sigterm_round=None,
                  kill_worker=None, kill_round=0, dead_p=0.0,
                  kill_host=None, kill_host_round=0,
+                 preempt_host=None, preempt_round=0, rejoin_after=1,
                  partition_host=None, partition_round=0,
                  slow_host=None, slow_host_s=0.0, slow_host_round=0,
                  slow_repeat=False,
@@ -134,6 +145,17 @@ class ChaosMonkey:
         # process SIGKILLs itself (maybe_kill_self), so the virtual
         # dead_hosts rendering must not double-fire on survivors
         self.kill_host_self_mode = False
+        # the preempt/rejoin cycle (spot fleets): preempt_host dies
+        # like kill_host at preempt_round, then comes back through the
+        # rendezvous rejoin_after rounds later (virtual hosts:
+        # ElasticPolicy.admit; real runs: a relaunched --grow process)
+        self.preempt_host = None if preempt_host is None \
+            else int(preempt_host)
+        self.preempt_round = int(preempt_round)
+        self.rejoin_after = max(1, int(rejoin_after))
+        self._preempt_fired = False
+        self._preempted_at = None
+        self._rejoin_fired = False
         self.partition_host = None if partition_host is None \
             else int(partition_host)
         self.partition_round = int(partition_round)
@@ -173,6 +195,8 @@ class ChaosMonkey:
                  "sigterm_round": int, "kill_worker": int,
                  "kill_round": int, "dead_p": float,
                  "kill_host": int, "kill_host_round": int,
+                 "preempt_host": int, "preempt_round": int,
+                 "rejoin_after": int,
                  "partition_host": int, "partition_round": int,
                  "slow_host": int, "slow_host_s": float,
                  "slow_host_round": int, "slow_repeat": truthy,
@@ -294,7 +318,31 @@ class ChaosMonkey:
             if 0 <= self.kill_host < n_hosts:
                 self._event("kill_host", host=self.kill_host, round=round_)
                 out.append(self.kill_host)
+        if self.preempt_host is not None and not self._preempt_fired \
+                and not self.kill_host_self_mode \
+                and round_ >= self.preempt_round:
+            self._preempt_fired = True
+            if 0 <= self.preempt_host < n_hosts:
+                self._event("preempt_host", host=self.preempt_host,
+                            round=round_)
+                self._preempted_at = round_
+                out.append(self.preempt_host)
         return out
+
+    def rejoining_hosts(self, round_):
+        """Host ids rejoining through the rendezvous at ``round_`` —
+        the second half of preempt_host: rejoin_after rounds after the
+        virtual preemption the host is back and ElasticPolicy ADMITS
+        it (a host_joined event). Empty until the preempt fired, and
+        always empty in real multi-process runs (kill_host_self_mode),
+        where the rejoin is a real relaunched `--grow` process."""
+        if self._rejoin_fired or self._preempted_at is None:
+            return []
+        if round_ - self._preempted_at < self.rejoin_after:
+            return []
+        self._rejoin_fired = True
+        self._event("rejoin_host", host=self.preempt_host, round=round_)
+        return [self.preempt_host]
 
     def maybe_kill_self(self, host, round_, on_kill=None):
         """The REAL multi-process rendering of kill_host: the targeted
@@ -308,6 +356,26 @@ class ChaosMonkey:
             return False
         self._host_kill_fired = True
         self._event("kill_host", host=host, round=round_, via="SIGKILL")
+        if on_kill is not None:
+            try:
+                on_kill()
+            except Exception:
+                pass
+        os.kill(os.getpid(), signal.SIGKILL)
+        return True                           # not reached
+
+    def maybe_preempt_self(self, host, round_, on_kill=None):
+        """The REAL multi-process rendering of preempt_host: identical
+        crash shape to maybe_kill_self (SIGKILL at the gate, lease
+        expiry on the survivors), but the orchestration layer —
+        scripts/smoke.sh's resize stage, an autoscaler — relaunches
+        the corpse with `--grow`, turning the cycle into a real rejoin
+        through the rendezvous."""
+        if self.preempt_host is None or host != self.preempt_host \
+                or round_ < self.preempt_round or self._preempt_fired:
+            return False
+        self._preempt_fired = True
+        self._event("preempt_host", host=host, round=round_, via="SIGKILL")
         if on_kill is not None:
             try:
                 on_kill()

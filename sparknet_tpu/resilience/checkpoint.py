@@ -45,7 +45,8 @@ class WorldMismatch(RuntimeError):
     damaged, try the next one", but a world mismatch damns every
     snapshot under the prefix equally — falling back (or silently
     starting fresh) would throw the run's history away. The operator
-    must either relaunch with the matching topology or choose a new
+    must either relaunch with the matching topology, opt into
+    cross-world resharding with ``--reshard auto``, or choose a new
     snapshot prefix; the message says exactly that."""
 
 
@@ -92,10 +93,60 @@ def check_world(entry, world, state_path):
         mismatches.append(f"mesh {want['mesh']} vs {world['mesh']}")
     if mismatches:
         raise WorldMismatch(
-            f"snapshot {state_path} was written by a different world "
+            f"snapshot {state_path} was written by a different world: "
+            f"snapshot world {want} vs this run's world {world} "
             f"({'; '.join(mismatches)} — snapshot first). Relaunch with "
-            "the topology the snapshot names, or start a new run under "
-            "a different snapshot prefix; refusing to guess.")
+            "the topology the snapshot names, pass `--reshard auto` "
+            "(restore(reshard=\"auto\")) to re-partition the snapshot "
+            "for this world, or start a new run under a different "
+            "snapshot prefix; refusing to guess.")
+
+
+def world_slots(sig):
+    """Worker-slot count a world signature describes: process count x
+    the product of the mesh's named axis sizes. This is the partition
+    count data ownership is spread over, so it is the unit
+    reshard_for_world() plans in."""
+    if not isinstance(sig, dict):
+        return None
+    n = int(sig.get("processes") or 1)
+    for size in (sig.get("mesh") or {}).values():
+        n *= int(size)
+    return n
+
+
+def reshard_for_world(from_world, to_world):
+    """Plan the re-partitioning that carries a snapshot stamped for
+    ``from_world`` (W1) onto the restoring run's ``to_world`` (W2), or
+    None when the worlds already agree (bit-for-bit restore, no plan).
+
+    The plan leans on the LocalSGD replication invariant: params and
+    optimizer history are REPLICATED across the consensus axis (every
+    worker holds the full tree after a consensus round), so the model
+    and state blobs themselves are world-shape independent — restoring
+    them under W2 needs no tensor surgery. What DOES change across
+    worlds is data ownership: W1's partitions must be re-spread over
+    W2's slots, and that mapping reuses the same round-robin
+    partition_owners rule eviction already uses (see
+    data/sampler.reshard_owners for the two directions). The snapshot
+    is re-stamped with W2's signature at the next save_snapshot; the
+    reshard itself is read-only, so a crash mid-restore leaves the
+    original snapshot untouched."""
+    a, b = world_slots(from_world), world_slots(to_world)
+    if a is None or b is None:
+        return None
+    if from_world == to_world:
+        return None
+    from ..data.sampler import reshard_owners
+    direction = "shrink" if b < a else ("grow" if b > a else "remap")
+    return {
+        "from_world": dict(from_world),
+        "to_world": dict(to_world),
+        "n_from": a,
+        "n_to": b,
+        "direction": direction,
+        "owners": [int(o) for o in reshard_owners(a, b)],
+    }
 
 
 def _sha256(path, chunk=1 << 20):
@@ -413,15 +464,21 @@ def find_resumable(prefix, log_fn=None, exclude=()):
     return None, skipped
 
 
-def check_restorable(state_path, world=None):
+def check_restorable(state_path, world=None, reshard="strict"):
     """Guard an explicit restore(): if a manifest in the snapshot's
     directory covers this state file, verify the whole pair and raise
     ValueError naming the snapshot and the reason when it fails. Temp
     files from torn writes are always refused. With ``world`` (the
     restoring run's world_signature), a stamped snapshot from a
-    different world raises WorldMismatch — the actionable error
-    instead of the cryptic reshape failure a silent restore would
-    produce. Un-manifested snapshots pass through (legacy callers)."""
+    different world raises WorldMismatch under ``reshard="strict"`` —
+    the actionable error instead of the cryptic reshape failure a
+    silent restore would produce — while ``reshard="auto"`` accepts
+    the entry so the caller can reshard_for_world() it. Returns the
+    matched manifest entry, or None for un-manifested snapshots
+    (legacy callers pass through)."""
+    if reshard not in ("strict", "auto"):
+        raise ValueError(f"reshard must be 'strict' or 'auto', "
+                         f"got {reshard!r}")
     if _TMP_TAG in os.path.basename(state_path):
         raise ValueError(f"refusing snapshot {state_path}: temp file from "
                          "an interrupted snapshot write")
@@ -437,11 +494,13 @@ def check_restorable(state_path, world=None):
                 if reason is not None:
                     raise ValueError(
                         f"refusing snapshot {state_path}: {reason}")
-                check_world(entry, world, state_path)
-                return
+                if reshard == "strict":
+                    check_world(entry, world, state_path)
+                return entry
+    return None
 
 
-def resume_auto(solver, prefix, log_fn=None):
+def resume_auto(solver, prefix, log_fn=None, reshard="strict"):
     """`--resume auto`: restore ``solver`` from the newest valid snapshot
     under ``prefix``; returns the state path used, or None (fresh start).
     Every refused snapshot is logged with its reason.
@@ -452,7 +511,12 @@ def resume_auto(solver, prefix, log_fn=None):
     can outlive files a crashed pruner already removed. A snapshot that
     verified but fails to RESTORE is therefore logged with the reason
     and excluded, and the search falls back to the next valid one
-    instead of killing the relaunch."""
+    instead of killing the relaunch. WorldMismatch is deliberately NOT
+    in the fallback set: a wrong-world stamp damns every snapshot under
+    the prefix equally, so it propagates instead of silently degrading
+    into a fresh start. ``reshard`` is passed through to
+    solver.restore() — "auto" re-partitions a cross-world snapshot for
+    this run's world instead of refusing it."""
     log = log_fn or (lambda *a: None)
     tried = []
     while True:
@@ -464,7 +528,10 @@ def resume_auto(solver, prefix, log_fn=None):
                 + "; starting fresh")
             return None
         try:
-            solver.restore(state)
+            if reshard == "strict":
+                solver.restore(state)
+            else:
+                solver.restore(state, reshard=reshard)
         except (OSError, ValueError, KeyError) as e:
             log(f"refusing snapshot {state}: restore failed ({e}); "
                 "falling back to the next valid snapshot")

@@ -355,8 +355,10 @@ class ElasticPolicy:
         self.alive = np.ones(self.n, bool)
         self.evictions = []             # [{worker, round, reason}, ...]
         self.readmissions = []          # [{worker, round}, ...]
+        self.admissions = []            # [{worker, round, via}, ...]
         self._bad_streak = np.zeros(self.n, np.int64)
         self._evicted_at = {}           # worker -> eviction round
+        self._admitted_at = {}          # worker -> admission round
         self._degraded_rounds = 0       # consecutive rounds not at full n
         self.quorum_lost = False
         # async version accounting (all no-ops while staleness is None)
@@ -422,6 +424,7 @@ class ElasticPolicy:
                "quorum": self.quorum, "unit": self.unit,
                "evictions": list(self.evictions),
                "readmissions": list(self.readmissions),
+               "admissions": list(self.admissions),
                "quorum_lost": self.quorum_lost}
         if self.staleness is not None:
             out.update(staleness=self.staleness,
@@ -485,6 +488,69 @@ class ElasticPolicy:
         if self.metrics is not None:
             self.metrics.log("readmission", **rec)
         return True
+
+    def admit(self, worker, round_idx, via="grow"):
+        """Admit ``worker`` into the world mid-run — the grow twin of
+        evict/readmit (ROADMAP item 4: cluster size as a runtime knob).
+        A known evicted slot is a readmission (a preempted host
+        rejoining through the rendezvous); a slot index at or beyond
+        the current world GROWS every per-worker array by append — the
+        same masked-collective trick that makes eviction free makes
+        admission free, because membership is host-side state and the
+        compiled round never sees the world size change (zero
+        recompiles). Either way the newcomer bootstraps from the
+        replicated consensus weights, exactly like a readmission.
+        Emits a ``membership`` admission record plus ``host_joined``
+        (host unit) so report/monitor render joins beside evictions."""
+        w = int(worker)
+        if w < 0:
+            return False
+        if w < self.n:
+            if self.alive[w] or not self.readmit(w, round_idx):
+                return False
+            self._record_admission(w, round_idx, via)
+            return True
+        front = int(self.version[self.alive].max()) if self.alive.any() \
+            else 0
+        grow = w + 1 - self.n
+        self.alive = np.append(self.alive, np.ones(grow, bool))
+        self._bad_streak = np.append(self._bad_streak,
+                                     np.zeros(grow, np.int64))
+        self.parked = np.append(self.parked, np.zeros(grow, bool))
+        # the newcomer joins at the front of the version clocks: the
+        # consensus it bootstraps from IS the freshest state
+        self.version = np.append(self.version,
+                                 np.full(grow, front, np.int64))
+        self.park_rounds = np.append(self.park_rounds,
+                                     np.zeros(grow, np.int64))
+        self._park_streak = np.append(self._park_streak,
+                                      np.zeros(grow, np.int64))
+        self._inbound_streak = np.append(self._inbound_streak,
+                                         np.zeros(grow, np.int64))
+        self._done_at = np.append(self._done_at,
+                                  np.full(grow, self._wall, np.float64))
+        self.n = w + 1
+        self._record_admission(w, round_idx, via)
+        return True
+
+    def _record_admission(self, w, round_idx, via):
+        # the round that just materialized ran with this slot masked
+        # out, so its validity bit is stale for the newcomer — exempt
+        # it from this round's bad-streak accounting or evict_after=1
+        # would re-evict every admission as "nonfinite" on arrival
+        self._admitted_at[w] = round_idx
+        rec = {"worker": w, "round": round_idx, "live": self.live_count(),
+               "unit": self.unit, "via": via, "world": self.n}
+        self.admissions.append(rec)
+        self.log(f"elastic: ADMITTED {self.unit} {w} at round {round_idx} "
+                 f"({via}); {self.live_count()}/{self.n} live, newcomer "
+                 "bootstraps from the consensus weights")
+        if self.metrics is not None:
+            self.metrics.log("membership", kind="admission", **rec)
+            if self.unit == "host":
+                self.metrics.log("host_joined", host=w, round=round_idx,
+                                 live=self.live_count(), via=via,
+                                 world=self.n)
 
     # -- bounded staleness: park / unpark / version clocks -------------------
     def park(self, worker, round_idx, lag=None):
@@ -618,11 +684,23 @@ class ElasticPolicy:
         if self.chaos is not None and hasattr(self.chaos, injector):
             for w in getattr(self.chaos, injector)(round_idx, self.n):
                 changed |= self.evict(w, round_idx, "chaos_kill")
+        if self.chaos is not None and \
+                hasattr(self.chaos, "rejoining_hosts") and \
+                self.unit == "host":
+            # preempt_host=H,rejoin_after=R (virtual hosts): the
+            # preempted host comes back through the rendezvous R rounds
+            # after its lease-drop, as an admission rather than the
+            # readmit cooldown below
+            for w in self.chaos.rejoining_hosts(round_idx):
+                changed |= self.admit(w, round_idx, via="rejoin")
         if valid is not None:
             v = np.asarray(valid, np.float64).ravel()[:self.n]
             for w in range(len(v)):
                 if not self.alive[w]:
                     continue
+                if self._admitted_at.get(w) == round_idx:
+                    continue    # admitted after this round ran: the
+                                # validity bit predates its membership
                 if v[w] > 0:
                     self._bad_streak[w] = 0
                     continue
@@ -657,6 +735,7 @@ class ElasticPolicy:
         self.alive = np.ones(self.n, bool)
         self._bad_streak = np.zeros(self.n, np.int64)
         self._evicted_at = {}
+        self._admitted_at = {}
         self._degraded_rounds = 0
         self.parked = np.zeros(self.n, bool)
         self.version = np.zeros(self.n, np.int64)

@@ -62,6 +62,23 @@ from .checkpoint import atomic_write_bytes, atomic_write_json
 _atomic_write_json = atomic_write_json
 
 
+def fresh_leases(directory, lease_s, now=None):
+    """{host: lease record} for every UNEXPIRED hb-*.json lease in a
+    rendezvous dir — the running world a late joiner (`--grow`)
+    discovers before it has a coordinator of its own (it picks host id
+    max(existing)+1 and leases itself into the same directory)."""
+    now = time.time() if now is None else now
+    out = {}
+    for p in glob.glob(os.path.join(glob.escape(str(directory)),
+                                    "hb-*.json")):
+        rec = _read_json(p)
+        if rec is None or not isinstance(rec.get("host"), int):
+            continue
+        if now - float(rec.get("stamp", 0.0)) <= float(lease_s):
+            out[rec["host"]] = rec
+    return out
+
+
 def _read_json(path):
     """Parse a JSON file, or None — a torn write must read as absent,
     not an error (the writer re-writes within interval_s)."""
@@ -96,7 +113,10 @@ class HeartbeatCoordinator:
     loop reads it; the mutable shared state (seq/round counters, the
     published liveness view, the stop flag) is guarded by ``_lock``
     (enforced by `sparknet lint` SPK201/202). Configuration fields
-    (dir/host/n/lease_s/...) are immutable after __init__."""
+    (dir/host/lease_s/...) are immutable after __init__; the world
+    size ``n`` is the one exception — admit_host() GROWS it (with the
+    view arrays, under ``_lock``) when a late-started `--grow` process
+    leases itself into the rendezvous dir mid-run."""
 
     def __init__(self, directory, host=None, n_hosts=None, interval_s=0.5,
                  lease_s=3.0, metrics=None, log_fn=print, chaos=None):
@@ -185,6 +205,17 @@ class HeartbeatCoordinator:
                 if rec is not None else 0.0
             if now - stamp <= self.lease_s:
                 continue
+            # re-read immediately before removing: a REJOINING host
+            # (chaos preempt/rejoin, a `--grow` relaunch) may have
+            # re-leased this exact path between our glob read and now —
+            # reaping its fresh lease would make the rejoin look like a
+            # second crash. Fresh-on-second-read means live: skip it.
+            rec2 = _read_json(p)
+            if rec2 is not None and \
+                    time.time() - float(rec2.get("stamp", 0.0)) \
+                    <= self.lease_s:
+                continue
+            rec = rec2 or rec
             try:
                 os.remove(p)
             except OSError:
@@ -339,6 +370,53 @@ class HeartbeatCoordinator:
         with self._lock:
             return set(self._ever_dead)
 
+    # -- grow-mid-run: late joiners through the rendezvous dir -------------
+    def poll_joiners(self):
+        """Host ids with a FRESH lease at or beyond this coordinator's
+        world size — late-started `--grow` processes leasing themselves
+        into the rendezvous dir, waiting to be admitted at the next
+        gate. Expired out-of-world leases (ghosts of a larger previous
+        run) are ignored; _reap_ghosts removed them at startup anyway."""
+        now = time.time()
+        return sorted(
+            h for h, rec in self.peers().items()
+            if h >= self.n and
+            now - float(rec.get("stamp", 0.0)) <= self.lease_s)
+
+    def admit_host(self, joiner):
+        """Grow this coordinator's world to include host ``joiner``:
+        the view arrays extend under ``_lock`` (the joiner starts
+        alive — its fresh lease is what got it here) and every later
+        view()/gate() spans the larger world. Returns True when the
+        world actually grew (idempotent across repeated polls)."""
+        j = int(joiner)
+        if j < self.n:
+            return False
+        with self._lock:
+            grow = j + 1 - self.n
+            self._alive_view = np.append(self._alive_view,
+                                         np.ones(grow, bool))
+            self._age_view = np.append(self._age_view,
+                                       np.zeros(grow, np.float64))
+            self.n = j + 1
+        self.log(f"heartbeat: host {j} joined the rendezvous; world "
+                 f"grown to {self.n} host(s)")
+        return True
+
+    def peer_round_max(self):
+        """The most advanced round any fresh peer lease announces, or
+        -1 — how a joiner fast-forwards its round counter to the front
+        of the running world before its first gate (incumbents' gates
+        accept any arrival at round >= theirs)."""
+        now = time.time()
+        front = -1
+        for h, rec in self.peers().items():
+            if h == self.host or \
+                    now - float(rec.get("stamp", 0.0)) > self.lease_s:
+                continue
+            front = max(front, int(rec.get("round", -1)))
+        return front
+
     # -- the pre-round rendezvous gate -------------------------------------
     def gate(self, round_idx, expect=None, timeout=None):
         """Arrive at ``round_idx`` and wait until every expected peer
@@ -357,6 +435,12 @@ class HeartbeatCoordinator:
             if hasattr(self.chaos, "maybe_kill_self"):
                 self.chaos.maybe_kill_self(self.host, round_idx,
                                            on_kill=self.stop)
+            if self.n > 1 and hasattr(self.chaos, "maybe_preempt_self"):
+                # preempt_host in a REAL multi-process world: same
+                # SIGKILL-at-the-gate crash shape as kill_host; the
+                # orchestration layer relaunches the corpse with --grow
+                self.chaos.maybe_preempt_self(self.host, round_idx,
+                                              on_kill=self.stop)
             if hasattr(self.chaos, "maybe_slow_host"):
                 self.chaos.maybe_slow_host(self.host, round_idx)
         self.announce_round(round_idx)
@@ -539,6 +623,11 @@ class FileConsensus:
                 x = np.asarray(parts[h][i], np.float64)
                 acc = x * w if acc is None else acc + x * w
             consensus.append(acc.astype(np.asarray(leaves[i]).dtype))
+        # admission skew (grow-mid-run): a peer that admitted a joiner
+        # this round can publish a mask including a host id >= our
+        # coord.n — size the aux vectors to the mask, not our (one
+        # round stale) world, so the report indexes without blowing up
+        n = max(n, max(parts) + 1)
         valid_vec = np.zeros(n, np.float32)
         loss_vec = np.full(n, np.nan, np.float32)
         div_sq = np.zeros(n, np.float32)

@@ -495,7 +495,7 @@ class Solver:
         return self.elastic
 
     def arm_heartbeat(self, directory, interval_s=0.5, lease_s=3.0,
-                      relay="auto", **kw):
+                      relay="auto", grow=False, **kw):
         """Arm host-level fault domains (resilience/heartbeat.py): this
         process leases its liveness into ``directory`` (shared storage
         every host reaches), a monitor thread marks peer hosts dead on
@@ -507,11 +507,42 @@ class Solver:
         through the directory (heartbeat.FileConsensus) when the
         backend has no multi-process collectives (multi-process CPU);
         True/False force it. Arm BEFORE arm_elastic so the membership
-        world sizes to the process count."""
+        world sizes to the process count.
+
+        grow: this is a LATE JOINER (`--grow`) — an independent
+        single-jax-process that grows an already-running world through
+        the rendezvous dir instead of launching inside a
+        jax.distributed fleet (which fixes membership at init and can
+        never admit anyone). The joiner scans the fresh leases, takes
+        host id max(existing)+1, forces the relay transport on, and
+        fast-forwards its round counter to the running world's front
+        at its first gate (LocalSGD); the incumbents' gates see the
+        new lease and admit it (HeartbeatCoordinator.admit_host +
+        ElasticPolicy.admit) with zero recompiles."""
         from ..resilience.heartbeat import (HeartbeatCoordinator,
-                                            FileConsensus)
+                                            FileConsensus, fresh_leases)
         host = jax.process_index()
         n = jax.process_count()
+        self._grow_pending = False
+        if grow:
+            if n > 1:
+                self.log("heartbeat: WARNING — --grow ignored inside a "
+                         f"{n}-process jax.distributed world (its "
+                         "membership is fixed at init); launch the "
+                         "joiner as a standalone single process")
+            else:
+                existing = fresh_leases(directory, lease_s)
+                if existing:
+                    host = max(existing) + 1
+                    n = host + 1
+                    relay = True if relay == "auto" else relay
+                    self._grow_pending = True
+                    self.log(f"heartbeat: joining a running world of "
+                             f"{len(existing)} host(s) "
+                             f"{sorted(existing)} as host {host}")
+                else:
+                    self.log("heartbeat: --grow found no fresh leases "
+                             f"under {directory}; starting a new world")
         kw.setdefault("metrics", self.metrics)
         kw.setdefault("log_fn", self.log)
         kw.setdefault("chaos", self.chaos)
@@ -1045,16 +1076,41 @@ class Solver:
         self.log(f"Snapshotting to {model_path}")
         return model_path, state_path
 
-    def restore(self, state_path):
+    def restore(self, state_path, reshard="strict"):
         """Resume from a .solverstate[.h5] (+ its learned_net weights).
         Snapshots a manifest marks partial/corrupt are refused with the
         reason; a snapshot stamped by a DIFFERENT world (process count
-        or mesh shape) raises WorldMismatch with the remedy
-        (resilience/checkpoint.py)."""
+        or mesh shape) raises WorldMismatch with the remedy under
+        ``reshard="strict"``, while ``reshard="auto"`` re-partitions it
+        for this run's world (resilience/checkpoint.py): params and
+        optimizer history are replicated across the consensus axis, so
+        the blobs restore unchanged and only data ownership re-spreads
+        (the reshard_for_world plan, emitted as a `reshard` event); the
+        snapshot is re-stamped for this world at the next snapshot."""
         from . import hdf5_io
         from ..resilience import checkpoint
-        checkpoint.check_restorable(
-            state_path, world=checkpoint.world_signature(self))
+        world = checkpoint.world_signature(self)
+        entry = checkpoint.check_restorable(
+            state_path, world=world, reshard=reshard)
+        self._reshard_plan = None
+        if reshard == "auto" and isinstance(entry, dict):
+            plan = checkpoint.reshard_for_world(entry.get("world"), world)
+            if plan is not None:
+                self._reshard_plan = plan
+                self.log(
+                    f"reshard: snapshot {state_path} written for world "
+                    f"{plan['from_world']} ({plan['n_from']} slots); "
+                    f"re-partitioning for this world {plan['to_world']} "
+                    f"({plan['n_to']} slots, {plan['direction']})")
+                if self.metrics is not None:
+                    self.metrics.log(
+                        "reshard", iter=int(entry.get("iter", 0)),
+                        state=state_path,
+                        from_world=plan["from_world"],
+                        to_world=plan["to_world"],
+                        n_from=plan["n_from"], n_to=plan["n_to"],
+                        direction=plan["direction"],
+                        owners=plan["owners"])
         self._it_dev = None          # re-seed the device iter counter
         if state_path.endswith(".h5"):
             it, learned, self.history = hdf5_io.load_state_hdf5(

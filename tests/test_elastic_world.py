@@ -1,0 +1,567 @@
+"""Elastic world resizing (ISSUE 12): cross-world checkpoint
+resharding and grow-mid-run.
+
+The invariant under test is the LocalSGD replication contract: params
+and optimizer history are replicated across the consensus axis after
+every round, so the snapshot blobs are world-shape independent and a
+reshard is pure membership bookkeeping — data ownership re-spreads
+over the new world's slots (data/sampler.reshard_owners) while the
+tensors restore unchanged. An 8-way run's checkpoint must resume on 4
+or 16 workers and reach the same loss trajectory to fp32 roundoff,
+and a live run must ADMIT a late-started host with zero recompiles.
+"""
+
+import hashlib
+import os
+import time
+
+import numpy as np
+import pytest
+
+from sparknet_tpu.proto import Message
+from sparknet_tpu.data.sampler import partition_owners, reshard_owners
+from sparknet_tpu.resilience import checkpoint
+from sparknet_tpu.resilience import heartbeat as hb_mod
+from sparknet_tpu.resilience.chaos import ChaosMonkey
+from sparknet_tpu.resilience.checkpoint import (
+    WorldMismatch, reshard_for_world, world_slots)
+from sparknet_tpu.resilience.elastic import ElasticPolicy
+from sparknet_tpu.resilience.heartbeat import (
+    FileConsensus, HeartbeatCoordinator, fresh_leases)
+from sparknet_tpu.utils.metrics import MetricsLogger
+
+
+class _Sink:
+    def __init__(self):
+        self.events = []
+
+    def log(self, event, **fields):
+        self.events.append(dict(fields, event=event))
+
+    def kinds(self):
+        return [e["event"] for e in self.events]
+
+
+def _mlp(batch):
+    """Per-worker-batch MLP: param shapes are batch-independent, so the
+    same snapshot restores under any per-worker batch — exactly the
+    property a cross-world resume relies on."""
+    net = Message("NetParameter", name="mlp")
+    net.add("layer", name="d", type="JavaData", top=["data"],
+            java_data_param=dict(shape=dict(dim=[batch, 8])))
+    net.add("layer", name="l", type="JavaData", top=["label"],
+            java_data_param=dict(shape=dict(dim=[batch])))
+    net.add("layer", name="fc1", type="InnerProduct", bottom=["data"],
+            top=["fc1"], inner_product_param=dict(
+                num_output=16, weight_filler=dict(type="xavier")))
+    net.add("layer", name="r1", type="ReLU", bottom=["fc1"], top=["fc1"])
+    net.add("layer", name="fc2", type="InnerProduct", bottom=["fc1"],
+            top=["fc2"], inner_product_param=dict(
+                num_output=4, weight_filler=dict(type="xavier")))
+    net.add("layer", name="loss", type="SoftmaxWithLoss",
+            bottom=["fc2", "label"], top=["loss"])
+    return net
+
+
+def _ls(workers, batch, metrics=None, tau=1):
+    from sparknet_tpu.parallel import LocalSGDSolver, make_mesh
+    sp = Message("SolverParameter", base_lr=0.05, lr_policy="fixed",
+                 momentum=0.9, display=0, random_seed=7)
+    return LocalSGDSolver(sp, mesh=make_mesh({"data": workers}), tau=tau,
+                          net_param=_mlp(batch), log_fn=None,
+                          metrics=metrics)
+
+
+def _batch(rows, seed):
+    rs = np.random.RandomState(seed)
+    return {"data": rs.randn(1, rows, 8).astype(np.float32),
+            "label": rs.randint(0, 4, (1, rows)).astype(np.int32)}
+
+
+def _tree_equal(a, b):
+    for lname in a:
+        for i, x in enumerate(a[lname]):
+            np.testing.assert_array_equal(np.asarray(x),
+                                          np.asarray(b[lname][i]))
+
+
+def _forge_world(prefix, world):
+    """Re-stamp every manifest entry as written by ``world`` — how the
+    tests fabricate snapshots from worlds this 8-device CPU container
+    cannot actually run (16-way, multi-process)."""
+    man = checkpoint.load_manifest(prefix)
+    for e in man["snapshots"]:
+        e["world"] = dict(world)
+    man["latest"]["world"] = dict(world)
+    checkpoint._atomic_write_json(checkpoint.manifest_path(prefix), man)
+
+
+def _sha(path):
+    return hashlib.sha256(open(path, "rb").read()).hexdigest()
+
+
+def _coord(tmp_path, host, n, interval=0.05, lease=0.4, **kw):
+    return HeartbeatCoordinator(str(tmp_path), host=host, n_hosts=n,
+                                interval_s=interval, lease_s=lease,
+                                log_fn=lambda *a: None, **kw)
+
+
+# ------------------------------------------------- the reshard plan ----
+
+class TestReshardOwners:
+    def test_shrink_spreads_round_robin(self):
+        o = reshard_owners(8, 4)
+        assert o.shape == (8,)
+        # surviving slots keep their own partition...
+        assert list(o[:4]) == [0, 1, 2, 3]
+        # ...and the 4 orphaned partitions re-spread one per survivor
+        assert sorted(o[4:]) == [0, 1, 2, 3]
+
+    def test_grow_bootstraps_every_new_slot(self):
+        o = reshard_owners(4, 16)
+        assert o.shape == (16,)
+        assert list(o[:4]) == [0, 1, 2, 3]
+        assert set(int(x) for x in o) == {0, 1, 2, 3}
+
+    def test_docstring_examples(self):
+        assert list(reshard_owners(4, 2)) == [0, 1, 0, 1]
+        assert list(reshard_owners(2, 4)) == [0, 1, 0, 1]
+
+    def test_identity(self):
+        assert list(reshard_owners(4, 4)) == [0, 1, 2, 3]
+
+    def test_rejects_empty_world(self):
+        with pytest.raises(ValueError, match="at least one slot"):
+            reshard_owners(0, 4)
+        with pytest.raises(ValueError, match="at least one slot"):
+            reshard_owners(4, -1)
+
+    def test_matches_partition_owners_contract(self):
+        # shrink is literally eviction's owner rule: the bottom slots
+        # stay alive, everything above re-spreads
+        alive = np.zeros(8, bool)
+        alive[:4] = True
+        np.testing.assert_array_equal(reshard_owners(8, 4),
+                                      partition_owners(8, alive))
+
+
+class TestReshardPlan:
+    W8 = {"processes": 1, "mesh": {"data": 8}}
+    W4 = {"processes": 1, "mesh": {"data": 4}}
+
+    def test_world_slots(self):
+        assert world_slots({"processes": 2, "mesh": {"data": 4}}) == 8
+        assert world_slots({"processes": 1}) == 1
+        assert world_slots(None) is None
+        assert world_slots("bogus") is None
+
+    def test_same_world_needs_no_plan(self):
+        assert reshard_for_world(self.W8, dict(self.W8)) is None
+
+    def test_shrink_and_grow_directions(self):
+        p = reshard_for_world(self.W8, self.W4)
+        assert p["direction"] == "shrink"
+        assert (p["n_from"], p["n_to"]) == (8, 4)
+        assert len(p["owners"]) == 8
+        p = reshard_for_world(self.W4, self.W8)
+        assert p["direction"] == "grow"
+        assert (p["n_from"], p["n_to"]) == (4, 8)
+        assert len(p["owners"]) == 8
+
+    def test_host_count_change_device_count_held_is_remap(self):
+        # 2 hosts x 4 devices -> 1 host x 8 devices: same slot count,
+        # different world — still a (trivial-ownership) reshard
+        p = reshard_for_world({"processes": 2, "mesh": {"data": 4}},
+                              self.W8)
+        assert p["direction"] == "remap"
+        assert (p["n_from"], p["n_to"]) == (8, 8)
+
+    def test_unstampable_world_has_no_plan(self):
+        assert reshard_for_world(None, self.W8) is None
+        assert reshard_for_world(self.W8, None) is None
+
+
+# ----------------------------------------- cross-world restore edges ----
+
+class TestCrossWorldRestore:
+    def test_8_to_4_restores_under_auto(self, tmp_path):
+        s8 = _ls(8, batch=16)
+        for r in range(2):
+            s8.train_round(_batch(128, seed=r))
+        prefix = str(tmp_path / "snap")
+        _, state = s8.snapshot(prefix=prefix)
+        s4 = _ls(4, batch=32)
+        with pytest.raises(WorldMismatch):
+            s4.restore(state)            # strict refuses...
+        s4.restore(state, reshard="auto")   # ...auto re-partitions
+        assert s4.iter == s8.iter
+        _tree_equal(s8.params, s4.params)
+        _tree_equal(s8.history, s4.history)
+        assert s4._reshard_plan["direction"] == "shrink"
+
+    def test_4_to_16_via_forged_stamp(self, tmp_path):
+        # the container has 8 CPU devices, so the 16-way side of the
+        # 4<->16 edge is fabricated by re-stamping the manifest as a
+        # 16-slot world's — the restore path only reads the stamp
+        s4 = _ls(4, batch=32)
+        s4.train_round(_batch(128, seed=0))
+        prefix = str(tmp_path / "snap")
+        _, state = s4.snapshot(prefix=prefix)
+        _forge_world(prefix, {"processes": 2, "mesh": {"data": 8}})
+        twin = _ls(4, batch=32)
+        with pytest.raises(WorldMismatch):
+            twin.restore(state)
+        twin.restore(state, reshard="auto")
+        assert twin.iter == s4.iter
+        _tree_equal(s4.params, twin.params)
+        p = twin._reshard_plan
+        assert p["direction"] == "shrink"
+        assert (p["n_from"], p["n_to"]) == (16, 4)
+
+    def test_processes_only_mismatch(self, tmp_path):
+        s = _ls(4, batch=32)
+        s.train_round(_batch(128, seed=0))
+        prefix = str(tmp_path / "snap")
+        _, state = s.snapshot(prefix=prefix)
+        _forge_world(prefix, {"processes": 4, "mesh": {"data": 4}})
+        twin = _ls(4, batch=32)
+        with pytest.raises(WorldMismatch, match="process count"):
+            twin.restore(state)
+        twin.restore(state, reshard="auto")
+        _tree_equal(s.params, twin.params)
+
+    def test_mismatch_message_names_both_worlds_and_remedy(self, tmp_path):
+        s = _ls(4, batch=32)
+        prefix = str(tmp_path / "snap")
+        _, state = s.snapshot(prefix=prefix)
+        _forge_world(prefix, {"processes": 1, "mesh": {"data": 8}})
+        twin = _ls(4, batch=32)
+        with pytest.raises(WorldMismatch) as ei:
+            twin.restore(state)
+        msg = str(ei.value)
+        assert "'data': 8" in msg        # the snapshot's world
+        assert "'data': 4" in msg        # this run's world
+        assert "--reshard auto" in msg   # the exact remedy
+        assert "Relaunch" in msg
+
+    def test_reshard_emits_event(self, tmp_path):
+        s8 = _ls(8, batch=16, metrics=str(tmp_path / "m8.jsonl"))
+        s8.train_round(_batch(128, seed=0))
+        prefix = str(tmp_path / "snap")
+        _, state = s8.snapshot(prefix=prefix)
+        mpath = tmp_path / "m4.jsonl"
+        s4 = _ls(4, batch=32, metrics=str(mpath))
+        s4.restore(state, reshard="auto")
+        s4.metrics.close()
+        import json
+        evs = [json.loads(ln) for ln in open(mpath)]
+        rs = [e for e in evs if e.get("event") == "reshard"]
+        assert len(rs) == 1
+        assert rs[0]["direction"] == "shrink"
+        assert (rs[0]["n_from"], rs[0]["n_to"]) == (8, 4)
+        assert rs[0]["from_world"]["mesh"] == {"data": 8}
+        assert rs[0]["to_world"]["mesh"] == {"data": 4}
+        assert len(rs[0]["owners"]) == 8
+
+    def test_restamped_at_next_snapshot(self, tmp_path):
+        s8 = _ls(8, batch=16)
+        prefix = str(tmp_path / "snap")
+        _, state = s8.snapshot(prefix=prefix)
+        s4 = _ls(4, batch=32)
+        s4.restore(state, reshard="auto")
+        s4.train_round(_batch(128, seed=1))
+        s4.snapshot(prefix=prefix)
+        man = checkpoint.load_manifest(prefix)
+        assert man["latest"]["world"]["mesh"] == {"data": 4}
+        # ...so a same-world resume of the resharded line is bit-for-bit
+        twin = _ls(4, batch=32)
+        twin.restore(os.path.join(str(tmp_path),
+                                  man["latest"]["state"]))
+        _tree_equal(s4.params, twin.params)
+
+    def test_torn_manifest_leaves_snapshot_untouched(self, tmp_path):
+        s8 = _ls(8, batch=16)
+        s8.train_round(_batch(128, seed=0))
+        prefix = str(tmp_path / "snap")
+        model, state = s8.snapshot(prefix=prefix)
+        shas = (_sha(model), _sha(state))
+        mp = checkpoint.manifest_path(prefix)
+        raw = open(mp, "rb").read()
+        with open(mp, "wb") as f:
+            f.write(raw[:len(raw) // 2])    # torn manifest commit
+        s4 = _ls(4, batch=32)
+        # a torn manifest reads as "no manifest": the snapshot falls
+        # back to the legacy unmanifested path instead of erroring,
+        # and the reshard never mutates the original files
+        s4.restore(state, reshard="auto")
+        assert (_sha(model), _sha(state)) == shas
+        assert not [p for p in os.listdir(tmp_path)
+                    if checkpoint._TMP_TAG in p]
+
+
+class TestNumericsContract:
+    def test_resharded_resume_matches_same_world_resume(self, tmp_path):
+        """The acceptance numerics contract: an 8-way run's checkpoint
+        resumed 4-way reaches the same loss/params as the same-world
+        resume at the next consensus round, to fp32 roundoff.
+
+        Why exact: with tau=1 and equal shard sizes, the averaged
+        update is p - mean_i(m*v + lr*g_i) = p - (m*v + lr*mean(g)),
+        and mean-of-8-sixteenths == mean-of-4-thirty-seconds of the
+        SAME 128-row global batch."""
+        s8 = _ls(8, batch=16)
+        for r in range(3):
+            s8.train_round(_batch(128, seed=r))
+        prefix = str(tmp_path / "snap")
+        _, state = s8.snapshot(prefix=prefix)
+
+        twin8 = _ls(8, batch=16)
+        twin8.restore(state)                 # same world: bit-for-bit
+        s4 = _ls(4, batch=32)
+        s4.restore(state, reshard="auto")    # resharded resume
+        _tree_equal(twin8.params, s4.params)
+
+        nxt = _batch(128, seed=99)           # the SAME global batch
+        l8 = float(twin8.train_round(nxt))
+        l4 = float(s4.train_round(nxt))
+        assert abs(l8 - l4) < 1e-4
+        for lname in twin8.params:
+            for a, b in zip(twin8.params[lname], s4.params[lname]):
+                np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                           rtol=2e-5, atol=1e-6)
+
+
+# ------------------------------------------- resume_auto regression ----
+
+class TestResumeAutoWorlds:
+    def _two_snapshots(self, tmp_path):
+        s = _ls(4, batch=32)
+        prefix = str(tmp_path / "snap")
+        s.train_round(_batch(128, seed=0))
+        s.snapshot(prefix=prefix)
+        s.train_round(_batch(128, seed=1))
+        _, newest = s.snapshot(prefix=prefix)
+        return s, prefix, newest
+
+    def test_fallback_does_not_swallow_world_mismatch(self, tmp_path):
+        """The satellite regression: the retention-race fallback loop
+        catches (OSError, ValueError, KeyError) — WorldMismatch must
+        NOT be in that set, or a wrong-world relaunch silently starts
+        fresh. Corrupting the newest snapshot forces the loop to the
+        older one, whose forged stamp must still propagate."""
+        s, prefix, newest = self._two_snapshots(tmp_path)
+        with open(newest, "r+b") as f:       # newest fails checksum...
+            f.seek(0)
+            f.write(b"\xff" * 64)
+        _forge_world(prefix, {"processes": 2, "mesh": {"data": 4}})
+        twin = _ls(4, batch=32)
+        with pytest.raises(WorldMismatch):   # ...and the older RAISES
+            checkpoint.resume_auto(twin, prefix, log_fn=lambda *a: None)
+
+    def test_auto_reshards_through_the_fallback(self, tmp_path):
+        s, prefix, newest = self._two_snapshots(tmp_path)
+        with open(newest, "r+b") as f:
+            f.seek(0)
+            f.write(b"\xff" * 64)
+        _forge_world(prefix, {"processes": 2, "mesh": {"data": 4}})
+        twin = _ls(4, batch=32)
+        state = checkpoint.resume_auto(twin, prefix,
+                                       log_fn=lambda *a: None,
+                                       reshard="auto")
+        assert state is not None and state != newest
+        assert twin.iter == 1                # the older snapshot
+        assert twin._reshard_plan["direction"] == "shrink"
+
+    def test_auto_same_world_is_plain_resume(self, tmp_path):
+        s, prefix, newest = self._two_snapshots(tmp_path)
+        twin = _ls(4, batch=32)
+        state = checkpoint.resume_auto(twin, prefix,
+                                       log_fn=lambda *a: None,
+                                       reshard="auto")
+        assert state == newest
+        assert twin._reshard_plan is None    # same world: no plan
+        _tree_equal(s.params, twin.params)
+
+
+# ------------------------------------------------ grow-mid-run: admit ----
+
+class TestElasticAdmit:
+    def test_admit_grows_the_world(self):
+        sink = _Sink()
+        p = ElasticPolicy(n_workers=2, quorum=1, unit="host",
+                          metrics=sink, log_fn=None)
+        assert p.admit(3, round_idx=4)
+        assert p.n == 4 and p.live() == [0, 1, 2, 3]
+        assert p.alive_f32().shape == (4,)
+        assert len(p.shard_owners()) == 4
+        hj = [e for e in sink.events if e["event"] == "host_joined"]
+        assert hj and hj[0]["host"] == 3 and hj[0]["world"] == 4
+        assert hj[0]["via"] == "grow"
+        adm = [e for e in sink.events if e["event"] == "membership"
+               and e.get("kind") == "admission"]
+        assert adm and adm[0]["worker"] == 3
+        assert p.summary()["admissions"]
+
+    def test_admit_is_idempotent_and_bounded(self):
+        p = ElasticPolicy(n_workers=2, quorum=1, log_fn=None)
+        assert p.admit(2, 1)
+        assert not p.admit(2, 2)             # already alive
+        assert not p.admit(-1, 2)
+        assert p.n == 3
+
+    def test_admit_of_evicted_slot_is_a_readmission(self):
+        sink = _Sink()
+        p = ElasticPolicy(n_workers=3, quorum=1, unit="host",
+                          metrics=sink, log_fn=None)
+        p.evict(1, 2, "lease_expired")
+        assert p.admit(1, 5, via="rejoin")
+        assert p.live() == [0, 1, 2] and p.n == 3
+        assert [e["event"] for e in sink.events].count("readmission") == 1
+        hj = [e for e in sink.events if e["event"] == "host_joined"]
+        assert hj and hj[0]["via"] == "rejoin"
+
+    def test_worker_unit_admission_has_no_host_event(self):
+        sink = _Sink()
+        p = ElasticPolicy(n_workers=2, quorum=1, unit="worker",
+                          metrics=sink, log_fn=None)
+        p.admit(2, 1)
+        assert "host_joined" not in sink.kinds()
+        assert "membership" in sink.kinds()
+
+
+# -------------------------------------- grow-mid-run: the rendezvous ----
+
+class TestHeartbeatGrow:
+    def test_fresh_leases_discovers_the_running_world(self, tmp_path):
+        a = _coord(tmp_path, 0, 2).start()
+        b = _coord(tmp_path, 1, 2).start()
+        try:
+            time.sleep(0.15)
+            leases = fresh_leases(str(tmp_path), 0.4)
+            assert sorted(leases) == [0, 1]
+        finally:
+            a.stop()
+            b.stop()
+        time.sleep(0.5)
+        assert fresh_leases(str(tmp_path), 0.05) == {}
+
+    def test_poll_and_admit_joiner(self, tmp_path):
+        a = _coord(tmp_path, 0, 1).start()
+        j = _coord(tmp_path, 1, 2).start()   # the late --grow process
+        try:
+            deadline = time.time() + 5
+            while time.time() < deadline and a.poll_joiners() != [1]:
+                time.sleep(0.05)
+            assert a.poll_joiners() == [1]
+            assert a.admit_host(1)
+            assert a.n == 2
+            alive, age = a.view()
+            assert list(alive) == [True, True]
+            assert not a.admit_host(1)       # idempotent
+        finally:
+            a.stop()
+            j.stop()
+
+    def test_peer_round_max_fast_forwards_the_joiner(self, tmp_path):
+        hb_mod._atomic_write_json(
+            str(tmp_path / "hb-0.json"),
+            {"host": 0, "seq": 12, "round": 7, "stamp": time.time()})
+        j = _coord(tmp_path, 1, 2)
+        assert j.peer_round_max() == 7       # joiner starts at front+1
+
+    def test_reap_spares_a_rejoining_hosts_fresh_lease(
+            self, tmp_path, monkeypatch):
+        """The satellite interplay: ghost GC saw a stale lease, but the
+        host re-leased (a rejoin) between the first read and the
+        remove — the re-read must spare it."""
+        p = tmp_path / "hb-1.json"
+        hb_mod._atomic_write_json(
+            str(p), {"host": 1, "seq": 1, "round": 0,
+                     "stamp": time.time() - 999})
+        real_read = hb_mod._read_json
+        state = {"n": 0}
+
+        def racy_read(path):
+            rec = real_read(path)
+            if os.path.basename(str(path)) == "hb-1.json":
+                state["n"] += 1
+                if state["n"] == 1:          # rejoin lands mid-reap
+                    hb_mod._atomic_write_json(
+                        str(p), {"host": 1, "seq": 2, "round": 3,
+                                 "stamp": time.time()})
+            return rec
+
+        monkeypatch.setattr(hb_mod, "_read_json", racy_read)
+        c = _coord(tmp_path, 0, 2)
+        c._reap_ghosts()
+        assert p.exists()                    # the fresh lease survived
+        monkeypatch.setattr(hb_mod, "_read_json", real_read)
+        assert hb_mod._read_json(str(p))["seq"] == 2
+
+    def test_reap_still_removes_true_ghosts(self, tmp_path):
+        p = tmp_path / "hb-1.json"
+        hb_mod._atomic_write_json(
+            str(p), {"host": 1, "seq": 1, "round": 0,
+                     "stamp": time.time() - 999})
+        c = _coord(tmp_path, 0, 2)
+        c._reap_ghosts()
+        assert not p.exists()
+
+    def test_consensus_aux_sized_to_admission_skew(self, tmp_path):
+        """A peer that admitted a joiner this round publishes a mask
+        spanning a host id >= our (one round stale) world — the aux
+        vectors must size to the mask, not coord.n."""
+        c0 = _coord(tmp_path, 0, 2)
+        fc0 = FileConsensus(c0)
+        leaves = [np.ones(3, np.float32)]
+        for h in (1, 2):
+            FileConsensus(_coord(tmp_path, h, 3))._post(
+                0, [np.full(3, float(h + 1), np.float32)], True, 0.5)
+        out, aux = fc0.exchange(0, leaves, valid=True, loss=0.1,
+                                alive_hosts=[0, 1, 2])
+        assert aux["valid"].shape == (3,)    # not coord.n == 2
+        assert aux["n_live"] == 3
+        np.testing.assert_allclose(out[0], np.full(3, 2.0), rtol=1e-6)
+
+
+# -------------------------------------------- chaos: preempt + rejoin ----
+
+class TestPreemptChaos:
+    def test_grammar_parses(self):
+        m = ChaosMonkey.parse(
+            "preempt_host=1,preempt_round=2,rejoin_after=3")
+        assert m.preempt_host == 1
+        assert m.preempt_round == 2
+        assert m.rejoin_after == 3
+
+    def test_unknown_key_still_rejected(self):
+        with pytest.raises(ValueError, match="unknown injector"):
+            ChaosMonkey.parse("preempt_hosts=1")
+
+    def test_virtual_preempt_then_rejoin_cycle(self):
+        sink = _Sink()
+        m = ChaosMonkey(preempt_host=1, preempt_round=1, rejoin_after=2,
+                        metrics=sink, log_fn=None)
+        p = ElasticPolicy(n_workers=3, quorum=1, unit="host", chaos=m,
+                          metrics=sink, log_fn=None)
+        p.observe_round(0)
+        assert p.live() == [0, 1, 2]
+        p.observe_round(1)                   # preempted: lease drops
+        assert p.live() == [0, 2]
+        p.observe_round(2)                   # still gone (< rejoin_after)
+        assert p.live() == [0, 2]
+        p.observe_round(3)                   # back through the rendezvous
+        assert p.live() == [0, 1, 2]
+        kinds = sink.kinds()
+        assert "host_evicted" in kinds and "host_joined" in kinds
+        hj = [e for e in sink.events if e["event"] == "host_joined"]
+        assert hj[0]["host"] == 1 and hj[0]["via"] == "rejoin"
+        # the cycle fires exactly once
+        p.observe_round(4)
+        assert len(hj) == 1
+
+    def test_preempt_suppressed_in_real_multiprocess_mode(self):
+        m = ChaosMonkey(preempt_host=1, preempt_round=0, log_fn=None)
+        m.kill_host_self_mode = True         # heartbeat owns the kill
+        assert m.dead_hosts(0, 3) == []
+        assert m.rejoining_hosts(5) == []    # never fired
