@@ -142,12 +142,24 @@ def bench_hostfed(name, net_param, batch_size, src_size, crop, classes,
     Also measures the two legs separately — pure H2D transfer of one
     uint8 batch, and the device step with a resident batch — so the row
     records *why* end-to-end lands where it does: good overlap means
-    end-to-end ~= max(transfer, step)."""
+    end-to-end ~= max(transfer, step).
+
+    The input-pipeline levers (PERF.md "Input pipeline") are read from
+    their SPARKNET_* env vars, so one env var flips this row between the
+    raw baseline and any lever arm: SPARKNET_WIRE re-encodes the shipped
+    batch (data/wire.py — h2d_kb_per_image reports the ACTUAL shipped
+    bytes), SPARKNET_STAGING=on routes the feed through the rotating-slot
+    H2DStager, SPARKNET_ECHO=E serves each transferred batch E times with
+    fresh crop/mirror draws."""
+    import os
     import jax
     import jax.numpy as jnp
-    from sparknet_tpu.data.prefetch import PrefetchIterator
+    from sparknet_tpu.data.prefetch import (PrefetchIterator, H2DStager,
+                                            EchoIterator)
     from sparknet_tpu.data.device_transform import DeviceTransformer
     from sparknet_tpu.data.transforms import DataTransformer
+    from sparknet_tpu.data.wire import (WireCodec, wire_mode_from_env,
+                                        wire_bits_from_env)
     from sparknet_tpu.proto import Message
 
     solver = _mk_solver(net_param)
@@ -156,16 +168,6 @@ def bench_hostfed(name, net_param, batch_size, src_size, crop, classes,
     host_t = DataTransformer(tp, phase=0, rng=np.random.RandomState(1))
     devt = DeviceTransformer(host_t)
     rec_shape = (3, src_size, src_size)
-    base_fn = devt.device_fn()
-
-    def tf(b):
-        # match the synthetic row's activation dtype (bf16) so the two
-        # rows isolate the input pipeline, not a compute-dtype change
-        b = base_fn(b)
-        b["data"] = b["data"].astype(jnp.bfloat16)
-        return b
-    solver.set_input_transform(
-        tf, raw_overrides=devt.raw_overrides(batch_size, rec_shape))
 
     rs = np.random.RandomState(0)
     pool = rs.randint(0, 256, (batch_size * 2, 3, src_size, src_size),
@@ -173,26 +175,49 @@ def bench_hostfed(name, net_param, batch_size, src_size, crop, classes,
     labels = rs.randint(0, classes, batch_size * 2).astype(np.int32)
     prng = np.random.RandomState(2)
 
+    wire_mode = wire_mode_from_env()
+    echo = max(1, int(os.environ.get("SPARKNET_ECHO", "1") or 1))
+    staging = os.environ.get("SPARKNET_STAGING", "") == "on"
+    codec = WireCodec(devt, rec_shape, mode=wire_mode,
+                      bits=wire_bits_from_env(), sample=pool) \
+        if wire_mode != "raw" else None
+    if echo > 1 and codec is not None and codec.precrop:
+        raise ValueError("SPARKNET_ECHO > 1 is incompatible with a "
+                         "precrop wire mode (crops are baked into the "
+                         "shipped bytes)")
+
+    inner0 = devt.device_fn(precropped=codec.precrop if codec else False)
+
+    def cast_fn(b):
+        # match the synthetic row's activation dtype (bf16) so the two
+        # rows isolate the input pipeline, not a compute-dtype change
+        b = inner0(b)
+        b["data"] = b["data"].astype(jnp.bfloat16)
+        return b
+    tf = codec.device_fn(inner=cast_fn) if codec else cast_fn
+    over = codec.raw_overrides(batch_size) if codec \
+        else devt.raw_overrides(batch_size, rec_shape)
+    solver.set_input_transform(tf, raw_overrides=over)
+
     def host_batch():
         idx = prng.randint(0, len(pool) - batch_size + 1)
-        return {"data": pool[idx:idx + batch_size],
-                "label": labels[idx:idx + batch_size],
-                **devt.aux(batch_size, rec_shape)}
+        b = {"data": pool[idx:idx + batch_size],
+             "label": labels[idx:idx + batch_size],
+             **devt.aux(batch_size, rec_shape)}
+        return codec.encode(b) if codec else b
 
-    def produce():
-        while True:
-            yield {k: jax.device_put(v) for k, v in host_batch().items()}
+    # ACTUAL shipped bytes per image (pixel wire + labels + aux draws)
+    kb_per_image = sum(v.nbytes for v in host_batch().values()) \
+        / batch_size / 1024.0
 
-    # leg 1: pure H2D transfer (uint8 batch + aux), synced per batch
+    def _sync_d(d):
+        return float(jnp.sum(d["data"].ravel()[:4].astype(jnp.float32)))
+
+    # leg 1: pure H2D transfer (encoded batch + aux), synced per batch
     def put_once():
         return {k: jax.device_put(v) for k, v in host_batch().items()}
-    d = put_once()
-    _sync = float(jnp.sum(d["data"][0, 0, 0, :4].astype(jnp.float32)))
-    t_dt, t_dts = _time_windows(
-        put_once,
-        lambda d: float(jnp.sum(d["data"][0, 0, 0, :4]
-                                .astype(jnp.float32))),
-        iters=5, windows=3)
+    _sync_d(put_once())
+    t_dt, t_dts = _time_windows(put_once, _sync_d, iters=5, windows=3)
     transfer_img_s = batch_size * 5 / t_dt
 
     # leg 2: device step with a RESIDENT raw batch (no transfer in loop)
@@ -204,8 +229,23 @@ def bench_hostfed(name, net_param, batch_size, src_size, crop, classes,
                             windows=3)
     step_img_s = batch_size * ITERS / s_dt
 
-    # end to end: prefetch worker device_puts ahead of the step
-    it = PrefetchIterator(produce(), depth=3)
+    # end to end: the feed staged ahead of the step in a prefetch worker —
+    # rotating-slot non-blocking staging when SPARKNET_STAGING=on, the
+    # classic blocking device_put-in-worker otherwise
+    stager = H2DStager(slots=2) if staging else None
+
+    def produce():
+        while True:
+            if stager is not None:
+                yield host_batch()
+            else:
+                yield {k: jax.device_put(v) for k, v in host_batch().items()}
+
+    it = PrefetchIterator(produce(), depth=3, transform=stager)
+    if echo > 1:
+        it = EchoIterator(it, echo,
+                          fresh_aux=lambda b: devt.aux(batch_size,
+                                                       rec_shape))
     try:
         for _ in range(WARMUP):
             loss = solver.train_step(next(it))
@@ -218,15 +258,26 @@ def bench_hostfed(name, net_param, batch_size, src_size, crop, classes,
     row = {"model": name, "mode": "host_fed", "batch": batch_size,
            "images_per_sec": round(img_s, 2),
            "images_per_sec_spread": _rate_stats(batch_size * ITERS, dts),
-           "h2d_kb_per_image": round(int(np.prod(rec_shape)) / 1024, 1),
+           "h2d_kb_per_image": round(kb_per_image, 1),
+           "wire": wire_mode, "echo": echo, "staging": int(staging),
            "transfer_only_images_per_sec": round(transfer_img_s, 2),
            "transfer_only_spread": _rate_stats(batch_size * 5, t_dts),
-           "device_step_images_per_sec": round(step_img_s, 2)}
+           "device_step_images_per_sec": round(step_img_s, 2),
+           # sharded-ingest view: this process's feed leg, and what the
+           # fleet aggregates to when every host feeds its own partition
+           "per_host_feed_images_per_sec": round(transfer_img_s, 2),
+           "feed_processes": jax.process_count(),
+           "aggregate_feed_images_per_sec": round(
+               transfer_img_s * jax.process_count(), 2)}
+    if codec is not None and codec.packing:
+        row["wire_bits"] = codec.bits
     if peak:
         row["mfu"] = round(img_s * flops / peak, 4)
     bound = min(transfer_img_s, step_img_s)
     if bound > 0:
-        # >=1.0 means the prefetch overlap hides the cheaper leg entirely
+        # >=1.0 means the feed overlap hides the cheaper leg entirely;
+        # with echo, served img/s can exceed the transfer bound by up
+        # to the echo factor — that excess IS the lever working
         row["overlap_efficiency"] = round(img_s / bound, 3)
     if transfer_img_s < 0.1 * step_img_s:
         # machine-readable guard: this row measures the link, not the
@@ -285,12 +336,18 @@ def bench_transformer_lm(peak, seq_len=4096, batch=4, d_model=512,
 # epilogue -> googlenet b256 (the one 3-op conv+relu+lrn site lives in
 # its conv2 tower), scan/remat -> the d512x6 LM row (per-layer dispatch
 # overhead), overlap -> data-parallel caffenet (the grad allreduce).
+# The input-pipeline levers (wire/staging/echo) A/B the HOST-FED feed
+# path instead of a compute trace — run_feed_ablation.
 ABLATE_ENVS = {
     "epilogue": ("SPARKNET_EPILOGUE", "off", "on"),
     "scan": ("SPARKNET_SCAN", "off", "on"),
     "remat": ("SPARKNET_REMAT", "none", "dots"),
     "overlap": ("SPARKNET_OVERLAP", "off", "on"),
+    "wire": ("SPARKNET_WIRE", "raw", "precrop+pack"),
+    "staging": ("SPARKNET_STAGING", "off", "on"),
+    "echo": ("SPARKNET_ECHO", "1", "4"),
 }
+FEED_LEVERS = ("wire", "staging", "echo")
 
 
 def run_ablation(lever, peak, emit):
@@ -305,6 +362,8 @@ def run_ablation(lever, peak, emit):
     import os
     import jax.numpy as jnp
     from sparknet_tpu.models import zoo
+    if lever in FEED_LEVERS:
+        return run_feed_ablation(lever, peak, emit)
     env, off_v, on_v = ABLATE_ENVS[lever]
     rs = np.random.RandomState(0)
     # SPARKNET_BENCH_TINY=1: shrink every workload to smoke-test the
@@ -403,6 +462,153 @@ def run_ablation(lever, peak, emit):
         if peak:
             row["mfu"] = round(rate * flops / peak, 4)
         emit(row)
+    return 0
+
+
+def run_feed_ablation(lever, peak, emit):
+    """--ablate {wire,staging,echo}: paired A/B over the HOST-FED feed
+    path. Same interleaved-window discipline as run_ablation, but each
+    arm builds the full pipeline — source pool, wire codec, prefetch,
+    staging, echo — under its env value, because these levers live in
+    the feed, not the compute trace.
+
+    The wire arm feeds a LOW-ENTROPY pool (pixel values 0..3, 2-bit
+    packable — the "optional lossless pack for low-entropy sources"
+    case) so the pack stage is active and the row's pool_bits field
+    says so; staging/echo arms feed full-range uint8."""
+    import os
+    import jax
+    import jax.numpy as jnp
+    from sparknet_tpu.data.prefetch import (PrefetchIterator, H2DStager,
+                                            EchoIterator)
+    from sparknet_tpu.data.device_transform import DeviceTransformer
+    from sparknet_tpu.data.transforms import DataTransformer
+    from sparknet_tpu.data.wire import (WireCodec, wire_mode_from_env,
+                                        wire_bits_from_env)
+    from sparknet_tpu.models import zoo
+    from sparknet_tpu.proto import Message
+
+    env, off_v, on_v = ABLATE_ENVS[lever]
+    tiny = bool(os.environ.get("SPARKNET_BENCH_TINY"))
+    low_entropy = lever == "wire"
+    if tiny:
+        # lenet keeps the crop geometry in play at smoke scale: 1x32x32
+        # source records cropped to lenet's 1x28x28 input
+        batch, ch, src, crop, classes = 16, 1, 32, 28, 10
+        model, mean_vals = "lenet", [128.0]
+
+        def mk_net():
+            return zoo.lenet(batch_size=batch)
+    else:
+        batch, ch, src, crop, classes = 256, 3, 256, 227, 1000
+        model, mean_vals = "caffenet", [104.0, 117.0, 123.0]
+
+        def mk_net():
+            return zoo.caffenet(batch_size=batch, num_classes=1000)
+    base = {"model": model, "batch": batch}
+
+    def build():
+        """Full feed pipeline under the CURRENT env -> (solver, it,
+        closers, info)."""
+        solver = _mk_solver(mk_net())
+        tp = Message("TransformationParameter", crop_size=crop, mirror=1)
+        tp.mean_value.extend(mean_vals)
+        devt = DeviceTransformer(
+            DataTransformer(tp, phase=0, rng=np.random.RandomState(1)))
+        rec_shape = (ch, src, src)
+        rs = np.random.RandomState(0)
+        pool = rs.randint(0, 4 if low_entropy else 256,
+                          (batch * 2, ch, src, src)).astype(np.uint8)
+        labels = rs.randint(0, classes, batch * 2).astype(np.int32)
+        prng = np.random.RandomState(2)
+        wire_mode = wire_mode_from_env()
+        codec = WireCodec(devt, rec_shape, mode=wire_mode,
+                          bits=wire_bits_from_env(), sample=pool) \
+            if wire_mode != "raw" else None
+        inner0 = devt.device_fn(precropped=codec.precrop if codec
+                                else False)
+
+        def cast_fn(b):
+            b = inner0(b)
+            b["data"] = b["data"].astype(jnp.bfloat16)
+            return b
+        tf = codec.device_fn(inner=cast_fn) if codec else cast_fn
+        over = codec.raw_overrides(batch) if codec \
+            else devt.raw_overrides(batch, rec_shape)
+        solver.set_input_transform(tf, raw_overrides=over)
+
+        def host_batch():
+            i = prng.randint(0, len(pool) - batch + 1)
+            b = {"data": pool[i:i + batch], "label": labels[i:i + batch],
+                 **devt.aux(batch, rec_shape)}
+            return codec.encode(b) if codec else b
+
+        kb = sum(v.nbytes for v in host_batch().values()) / batch / 1024.0
+        staging = os.environ.get("SPARKNET_STAGING", "") == "on"
+        echo = max(1, int(os.environ.get("SPARKNET_ECHO", "1") or 1))
+        stager = H2DStager(slots=2) if staging else None
+
+        def produce():
+            while True:
+                if stager is not None:
+                    yield host_batch()
+                else:
+                    yield {k: jax.device_put(v)
+                           for k, v in host_batch().items()}
+
+        it = PrefetchIterator(produce(), depth=3, transform=stager)
+        if echo > 1:
+            it = EchoIterator(it, echo,
+                              fresh_aux=lambda b: devt.aux(batch,
+                                                           rec_shape))
+        info = {"h2d_kb_per_image": round(kb, 1), "wire": wire_mode,
+                "echo": echo, "staging": int(staging)}
+        if low_entropy:
+            info["pool_bits"] = 2
+        if codec is not None and codec.packing:
+            info["wire_bits"] = codec.bits
+        return solver, it, info
+
+    arms = {}
+    for arm, val in (("baseline", off_v), (lever, on_v)):
+        old = os.environ.get(env)
+        os.environ[env] = val
+        try:
+            s, it, info = build()
+            for _ in range(WARMUP):
+                loss = s.train_step(next(it))
+            float(loss)
+            arms[arm] = (s, it, info, val)
+        finally:
+            os.environ.pop(env, None)
+            if old is not None:
+                os.environ[env] = old
+
+    try:
+        dts = {a: [] for a in arms}
+        for _ in range(WINDOWS):
+            for a, (s, it, _info, _v) in arms.items():
+                t0 = time.perf_counter()
+                for _ in range(ITERS):
+                    out = s.train_step(next(it))
+                float(out)
+                dts[a].append(time.perf_counter() - t0)
+
+        unit = batch * ITERS
+        for a, (s, it, info, val) in arms.items():
+            flops = model_train_flops_per_image(s)
+            rate = unit / min(dts[a])
+            row = dict(base, mode="ablation", ablation=lever, arm=a,
+                       **info)
+            row[env] = val
+            row["images_per_sec"] = round(rate, 1)
+            row["images_per_sec_spread"] = _rate_stats(unit, dts[a])
+            if peak:
+                row["mfu"] = round(rate * flops / peak, 4)
+            emit(row)
+    finally:
+        for _a, (_s, it, _info, _v) in arms.items():
+            it.close()
     return 0
 
 

@@ -39,6 +39,13 @@ echo "resize smoke OK"
 bash scripts/smoke.sh serve || exit 1
 echo "serve smoke OK"
 
+# input pipeline, end to end: a real 2-process run whose per-host
+# `ingest` events stay inside each host's owned record shard, and a
+# --echo 2 run beating the no-echo wall clock under chaos slow_h2d
+# (scripts/smoke.sh stage j)
+bash scripts/smoke.sh ingest || exit 1
+echo "ingest smoke OK"
+
 set -o pipefail
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu \

@@ -34,9 +34,18 @@
 #       request, drains on SIGTERM with exit 0, and `sparknet report`
 #       renders the serving section from the same metrics stream.
 #
-# Usage: smoke.sh [all|multihost|async|serve]  — `multihost`/`async`/
-# `serve` run only that stage (the fast CI wiring; scripts/ci.sh
-# invokes them individually).
+# Input pipeline (ISSUE 13):
+#   (j) a REAL 2-process run with sharded ingest: each host's `ingest`
+#       events in the metrics stream must stay inside its owned half of
+#       the record space (disjointness), both halves together must cover
+#       the dataset, and under chaos slow_h2d (a per-transfer stall on
+#       the simulated wire) a --echo 2 run must beat the no-echo wall
+#       clock — echoes reuse the transferred batch, so they skip the
+#       stall.
+#
+# Usage: smoke.sh [all|multihost|async|serve|ingest]  — the named
+# stages run alone (the fast CI wiring; scripts/ci.sh invokes them
+# individually).
 set -euo pipefail
 cd "$(dirname "$0")/.."
 export JAX_PLATFORMS=cpu
@@ -502,6 +511,115 @@ EOF
          "recompiles"
 }
 
+# ------------------------------------------------ input pipeline ----
+# (1) 2 real processes with sharded ingest (the default in multi-process
+# worlds): every host's throttled `ingest` read events must stay inside
+# the half of the record space it owns, and the two halves must tile the
+# dataset — the owned-records assertion straight from the metrics
+# stream. (2) chaos slow_h2d stalls every FRESH batch at the prefetch
+# hand-off; --echo 2 halves the fresh-batch count for the same round
+# count, so it must win wall clock by most of the skipped stall.
+run_ingest_stage() {
+    ig="$tmp/ingest"
+    mkdir -p "$ig"
+    port=$(python -c "import socket; s=socket.socket(); \
+s.bind(('localhost',0)); print(s.getsockname()[1])")
+    pids=()
+    for i in 0 1; do
+        SPARKNET_COORDINATOR="localhost:$port" \
+        SPARKNET_NUM_PROCESSES=2 SPARKNET_PROCESS_ID=$i \
+        XLA_FLAGS="--xla_force_host_platform_device_count=4" \
+        python -m sparknet_tpu cifar --workers 4 --hosts 2 --tau 2 \
+            --rounds 4 --test-every 100 --metrics "$ig/run$i.jsonl" \
+            --heartbeat-dir "$ig/rdv" --lease-s 5 \
+            --heartbeat-interval 0.2 --quorum 2 \
+            > "$ig/out$i.txt" 2>&1 &
+        pids+=($!)
+    done
+    for i in 0 1; do
+        rc=0; wait "${pids[$i]}" || rc=$?
+        test "$rc" -eq 0 || { echo "ingest host $i failed (rc=$rc):"
+                              cat "$ig/out$i.txt"; exit 1; }
+    done
+    grep -q "sharded ingest: host 0 owns" "$ig/out0.txt"
+    grep -q "sharded ingest: host 1 owns" "$ig/out1.txt"
+
+    python - "$ig" <<'EOF'
+import json, sys, os
+ig = sys.argv[1]
+own, spans = {}, {}
+for i in (0, 1):
+    evs = [json.loads(l) for l in open(os.path.join(ig, f"run{i}.jsonl"))]
+    ing = [e for e in evs if e.get("event") == "ingest"]
+    assert ing, f"host {i}: no ingest events in the metrics stream"
+    init = [e for e in ing if e["kind"] == "init"]
+    reads = [e for e in ing if e["kind"] == "read"]
+    assert len(init) == 1 and init[0]["host"] == i \
+        and init[0]["hosts"] == 2, f"host {i}: bad init {init}"
+    assert reads, f"host {i}: no throttled read events"
+    own[i] = init[0]["records"]
+    spans[i] = (min(e["lo"] for e in reads), max(e["hi"] for e in reads))
+    pf = [e for e in evs if e.get("event") == "prefetch"]
+    assert pf and pf[-1]["ingest_hosts"] == 2 \
+        and pf[-1]["ingest_records"] == own[i], \
+        f"host {i}: prefetch gauge missing ingest fields: {pf[-1:]}"
+# partitions are contiguous: host 0 owns [0, n0), host 1 [n0, n0+n1)
+n0, n1 = own[0], own[1]
+assert abs(n0 - n1) <= 1, f"lopsided split: {own}"
+assert 0 <= spans[0][0] and spans[0][1] < n0, \
+    f"host 0 read outside its shard: {spans[0]} vs [0, {n0})"
+assert n0 <= spans[1][0] and spans[1][1] < n0 + n1, \
+    f"host 1 read outside its shard: {spans[1]} vs [{n0}, {n0 + n1})"
+print(f"ingest: host 0 read {spans[0]} of [0, {n0}), "
+      f"host 1 read {spans[1]} of [{n0}, {n0 + n1}) — disjoint, "
+      f"{n0 + n1} records covered")
+EOF
+    python -m sparknet_tpu report "$ig/run0.jsonl" | tee "$ig/rep.txt" \
+        > /dev/null
+    grep -q "input pipeline" "$ig/rep.txt"
+    grep -q "sharded ingest" "$ig/rep.txt"
+
+    # -- data echoing vs the slowed wire --------------------------------
+    # the stall must exceed the ~7.6 s/round CPU compute or the depth-2
+    # prefetch hides it entirely: at 12 s/transfer the no-echo run is
+    # producer-bound (4 fresh batches = 48 s on the wire) while --echo 2
+    # ships only 2 fresh batches (24 s) and goes back to compute-bound
+    t0=$SECONDS
+    XLA_FLAGS="--xla_force_host_platform_device_count=4" \
+    python -m sparknet_tpu cifar --workers 2 --tau 1 --rounds 4 \
+        --test-every 100 --metrics "$ig/noecho.jsonl" \
+        --chaos "slow_h2d=12" > "$ig/noecho.out" 2>&1
+    noecho_s=$((SECONDS - t0))
+    t0=$SECONDS
+    XLA_FLAGS="--xla_force_host_platform_device_count=4" \
+    python -m sparknet_tpu cifar --workers 2 --tau 1 --rounds 4 \
+        --test-every 100 --metrics "$ig/echo.jsonl" \
+        --chaos "slow_h2d=12" --echo 2 > "$ig/echo.out" 2>&1
+    echo_s=$((SECONDS - t0))
+    # echo halves the wire time (24 s saved); demand a solid chunk of
+    # it back after pipeline overlap
+    test "$echo_s" -le "$((noecho_s - 8))" || {
+        echo "echo run did not beat the slowed wire: ${echo_s}s vs" \
+             "no-echo ${noecho_s}s"; exit 1; }
+    python - "$ig" <<'EOF'
+import json, sys, os
+ig = sys.argv[1]
+evs = [json.loads(l) for l in open(os.path.join(ig, "echo.jsonl"))]
+pf = [e for e in evs if e.get("event") == "prefetch"]
+assert pf and pf[-1].get("echo") == 2, f"echo gauge missing: {pf[-1:]}"
+assert any(e.get("event") == "chaos" and e.get("kind") == "slow_h2d"
+           for e in evs), "slow_h2d chaos event missing"
+EOF
+    echo "ingest stage OK: per-host reads stayed inside owned shards," \
+         "and --echo 2 beat the slowed wire (${echo_s}s vs" \
+         "${noecho_s}s)"
+}
+
+if [ "$stage" = "ingest" ]; then
+    run_ingest_stage
+    echo "SMOKE OK (ingest)"
+    exit 0
+fi
 if [ "$stage" = "resize" ]; then
     run_resize_stage
     echo "SMOKE OK (resize)"
@@ -717,5 +835,7 @@ run_async_stage
 run_multihost_stage
 
 run_serve_stage
+
+run_ingest_stage
 
 echo "SMOKE OK"

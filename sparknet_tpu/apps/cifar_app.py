@@ -84,6 +84,29 @@ class CifarApp:
             self.log("no CIFAR data dir; using synthetic class-gaussians")
             self.data = _SyntheticCifar(seed=seed or 0)
 
+        # input-pipeline levers (cli._apply_feed_flags / env):
+        #   echo E      — each round's batch is served E times (data
+        #                 echoing; CIFAR feeds are pre-transformed f32, so
+        #                 echoes reuse the batch as-is)
+        #   shard ingest — in a multi-process world, each host samples
+        #                 ONLY its owned partition of the record index
+        #                 space (data/ingest.py), instead of every host
+        #                 re-reading the full set
+        self.echo = max(1, int(os.environ.get("SPARKNET_ECHO", "1") or 1))
+        self.shard_ingest = \
+            os.environ.get("SPARKNET_SHARD_INGEST", "on") != "off"
+        self.ingest = None
+        if self.shard_ingest:
+            import jax
+            if jax.process_count() > 1:
+                from ..data.ingest import IngestShard
+                self.ingest = IngestShard(
+                    len(self.data.train_images), jax.process_index(),
+                    jax.process_count(), metrics=self.metrics)
+                self.log(f"sharded ingest: host {self.ingest.host} owns "
+                         f"{self.ingest.owned}/{len(self.data.train_images)}"
+                         f" records")
+
         # net: stock prototxt (with data layers swapped like
         # ProtoLoader.replaceDataLayers) or the built-in zoo twin
         scale = 1 if strategy == "local_sgd" else self.num_workers
@@ -129,6 +152,13 @@ class CifarApp:
             self._train_f32 = self.data.train_images.astype(np.float32) \
                 - self.data.mean_image
         imgs, labs = self._train_f32, self.data.train_labels
+        sh = self._current_ingest()
+        if sh is not None:
+            # per-host sharded ingest: the same random contiguous window,
+            # confined to (and wrapping within) this host's owned records
+            start = self.rng.randint(0, sh.owned)
+            idx = sh.take(start, n_images)
+            return imgs[idx], labs[idx]
         n = len(imgs)
         # random contiguous window (MinibatchSampler.scala:20-21), modular
         # so a request larger than the dataset wraps instead of raising
@@ -136,6 +166,19 @@ class CifarApp:
         start = self.rng.randint(0, n)
         idx = (start + np.arange(n_images)) % n
         return imgs[idx], labs[idx]
+
+    def _current_ingest(self):
+        """This host's ingest shard, re-spread if the elastic host
+        membership changed since it was built — ingest ownership follows
+        data ownership through the same partition_owners rule."""
+        sh = self.ingest
+        if sh is None:
+            return None
+        el = getattr(self.solver, "elastic", None)
+        if el is not None and el.unit == "host" and el.n == sh.hosts \
+                and not np.array_equal(el.alive, sh.alive):
+            sh = self.ingest = sh.respread(el.alive)
+        return sh
 
     def _slot_owners(self):
         """Per-SLOT re-spread owners when elastic evictions are in
@@ -240,7 +283,8 @@ class CifarApp:
         bootstraps its weights from the running world's checkpoint
         (the manifest is stamped for the incumbents' world, so a
         cross-world reshard is exactly what the joiner needs)."""
-        from ..data.prefetch import PrefetchIterator
+        from ..data.prefetch import PrefetchIterator, EchoIterator
+        from ..resilience.chaos import active_chaos
         from ..utils.watchdog import Watchdog
         from ..resilience import checkpoint
 
@@ -261,8 +305,27 @@ class CifarApp:
                       on_stall=lambda dt: self.log(
                           f"WATCHDOG: no round finished in {dt:.0f}s"),
                       on_nan=lambda v: self.log(f"WATCHDOG: loss = {v}"))
+        # slow_h2d chaos charges every FRESH round batch at the prefetch
+        # hand-off (the app feeds raw host arrays to train_round, so this
+        # is where "the wire" lives); echoed batches skip it — the
+        # wall-clock edge the smoke-test echo run asserts
+        ch = active_chaos()
+        gate = None
+        if ch is not None and getattr(ch, "slow_h2d", 0) > 0:
+            def gate(b):
+                vals = b.values() if isinstance(b, dict) else [b]
+                ch.maybe_slow_h2d(nbytes=sum(
+                    int(getattr(v, "nbytes", 0)) for v in vals))
+                return b
+        extra = {"echo": self.echo}
+        if self.ingest is not None:
+            extra["ingest_hosts"] = self.ingest.hosts
+            extra["ingest_records"] = self.ingest.owned
         batches = PrefetchIterator(self._round_stream(), depth=2,
-                                   metrics=metrics, name="round_feed")
+                                   transform=gate, metrics=metrics,
+                                   name="round_feed", extra=extra)
+        if self.echo > 1:
+            batches = EchoIterator(batches, self.echo)
         try:
             with wd:
                 for r in range(num_rounds):

@@ -122,7 +122,7 @@ def cmd_train(args):
     from .solver.solver import Solver, resolve_nets
     from .utils.signals import SignalPolicy
     from .utils.metrics import MetricsLogger
-    from .data.prefetch import PrefetchIterator
+    from .data.prefetch import PrefetchIterator, H2DStager, EchoIterator
     from .obs import Tracer, JaxProfiler
 
     import os
@@ -130,6 +130,12 @@ def cmd_train(args):
     # step/comms accounting, the prefetch gauges, and the CLI's phase
     # spans all land in the same JSONL (see sparknet_tpu.obs)
     _apply_perf_flags(args)   # before any net is compiled
+    _apply_feed_flags(args)   # before any data source is constructed
+    echo = max(1, int(os.environ.get("SPARKNET_ECHO", "1") or 1))
+    if echo > 1 and args.host_transform:
+        raise SystemExit(
+            "--echo > 1 needs the device-transform feed (drop "
+            "--host-transform): echoes re-draw crop/mirror on-device")
     metrics = MetricsLogger(args.metrics) if args.metrics else None
     tracer = Tracer(metrics)
     if args.chaos:
@@ -219,24 +225,56 @@ def cmd_train(args):
         else:
             solver.restore(args.resume, reshard=reshard)
     total = args.iterations or int(sp.max_iter) or 1000
-    # device_put in the prefetch WORKER thread: the blocking host->HBM copy
-    # of batch k+1 overlaps step k on the device (the H2D/compute overlap
-    # the reference got from cudaMemcpyAsync + prefetch threads). Only on
-    # the single-device, iter_size==1 path — the dp strategy re-shards via
-    # np.asarray (a blocking readback of anything already on device), and
-    # iter_size>1 stacks micro-batches on the host first.
+    # H2D in the prefetch WORKER thread, so batch k+1's host->HBM copy
+    # overlaps step k on the device (the overlap the reference got from
+    # cudaMemcpyAsync + prefetch threads). SPARKNET_STAGING=on (default)
+    # uses the rotating-slot H2DStager — puts DISPATCH non-blocking and
+    # only the transfer the consumer is about to need gets waited on —
+    # off reverts to the blocking device_put. Only on the single-device,
+    # iter_size==1 path: the dp strategy re-shards via np.asarray (a
+    # blocking readback of anything already on device), and iter_size>1
+    # stacks micro-batches on the host first.
     import jax
-    put = jax.device_put \
-        if args.strategy == "single" and int(sp.iter_size) <= 1 else None
+    from .resilience.chaos import active_chaos
+    staging = os.environ.get("SPARKNET_STAGING", "on") != "off"
+    stager = None
+    if args.strategy == "single" and int(sp.iter_size) <= 1:
+        if staging:
+            stager = H2DStager(slots=2, metrics=metrics, name="train_feed",
+                               chaos=active_chaos())
+            put = stager
+        else:
+            put = jax.device_put
+    else:
+        put = None
     if train_src is not None:
         kind = "device-cached" if hasattr(train_src, "nbytes") else (
             "device-transform" if getattr(train_src, "device_mode", False)
             else "host-transform")
         print(f"Training from {train_src.source} "
               f"({train_src.num_records} records, {kind})")
+        extra = {"echo": echo, "staging": int(put is stager
+                                              and stager is not None)}
+        codec = getattr(train_src, "wire", None)
+        if codec is not None:
+            extra.update(codec.describe())
         data_iter = PrefetchIterator(iter(train_src), depth=3,
                                      transform=put, metrics=metrics,
-                                     name="train_feed")
+                                     name="train_feed", extra=extra)
+        if echo > 1:
+            if hasattr(train_src, "nbytes"):
+                # device-cached feed: each "batch" is already a tiny
+                # on-device control array — nothing worth echoing
+                print("NOTE: --echo ignored for the device-cached feed")
+            elif not hasattr(train_src, "fresh_aux"):
+                raise SystemExit(
+                    f"--echo > 1 needs a source with re-drawable "
+                    f"device-side augmentation; "
+                    f"{type(train_src).__name__} has none")
+            else:
+                data_iter = EchoIterator(
+                    data_iter, echo,
+                    fresh_aux=lambda b: train_src.fresh_aux())
     else:
         print("WARNING: no Data-layer LMDB source found; "
               "feeding synthetic noise (shapes only)")
@@ -493,6 +531,7 @@ def cmd_time(args):
 def cmd_cifar(args):
     from .apps import CifarApp
     _apply_perf_flags(args)   # before app/solver construction
+    _apply_feed_flags(args)   # echo/shard-ingest land as env for the app
     if args.chaos:
         # arm BEFORE app/solver construction so active_chaos() sees it
         from .resilience.chaos import ChaosMonkey, install_chaos
@@ -860,6 +899,67 @@ def _apply_perf_flags(args):
         os.environ["SPARKNET_SCAN"] = args.scan
 
 
+def _add_feed_flags(p):
+    """Input-pipeline levers (PERF.md "Input pipeline"). Like the perf
+    flags, each writes its SPARKNET_* env var before any source/solver is
+    constructed — env-only use keeps working, and an A/B run differs by
+    exactly one variable."""
+    p.add_argument("--wire", default=None,
+                   choices=("raw", "precrop", "pack", "precrop+pack"),
+                   help="wire format for the device-transform feed: raw "
+                        "uint8 records (default), host-side pre-crop to "
+                        "the net's input geometry (crop/mirror still "
+                        "applied on-device, bit-exact), lossless bit-pack "
+                        "for low-entropy sources, or both. Default: "
+                        "SPARKNET_WIRE env var, else raw")
+    p.add_argument("--wire-bits", type=int, choices=(1, 2, 4, 8),
+                   default=None,
+                   help="pack width for --wire pack modes (8 = no pack); "
+                        "default: SPARKNET_WIRE_BITS env var, else "
+                        "inferred from the first record and enforced "
+                        "losslessly (out-of-range batches raise)")
+    p.add_argument("--staging", choices=("on", "off"), default=None,
+                   help="true double-buffered H2D staging: dispatch batch "
+                        "N+1's transfer non-blocking into a rotating slot "
+                        "while step N runs (data/prefetch.py H2DStager). "
+                        "off = the blocking device_put in the prefetch "
+                        "worker. Default: SPARKNET_STAGING env var, "
+                        "else on")
+    p.add_argument("--echo", type=int, default=None, metavar="E",
+                   help="data echoing: serve each transferred batch E "
+                        "times, with fresh on-device crop/mirror draws "
+                        "per echo (Choi et al.) — for transfer-bound "
+                        "links. Default: SPARKNET_ECHO env var, else 1")
+    p.add_argument("--shard-ingest", choices=("on", "off"), default=None,
+                   help="per-host sharded ingest in multi-process runs: "
+                        "each host reads only its owned record partition "
+                        "(data/ingest.py; ownership re-spreads with "
+                        "elastic membership). Default: "
+                        "SPARKNET_SHARD_INGEST env var, else on")
+
+
+def _apply_feed_flags(args):
+    import os
+    if getattr(args, "wire", None) is not None:
+        os.environ["SPARKNET_WIRE"] = args.wire
+    if getattr(args, "wire_bits", None) is not None:
+        os.environ["SPARKNET_WIRE_BITS"] = str(args.wire_bits)
+    if getattr(args, "staging", None) is not None:
+        os.environ["SPARKNET_STAGING"] = args.staging
+    if getattr(args, "echo", None) is not None:
+        os.environ["SPARKNET_ECHO"] = str(args.echo)
+    if getattr(args, "shard_ingest", None) is not None:
+        os.environ["SPARKNET_SHARD_INGEST"] = args.shard_ingest
+    echo = int(os.environ.get("SPARKNET_ECHO", "1") or 1)
+    wire = os.environ.get("SPARKNET_WIRE", "raw") or "raw"
+    if echo > 1 and "precrop" in wire:
+        raise SystemExit(
+            "--echo > 1 is incompatible with a precrop wire mode: "
+            "pre-cropping bakes the crop window into the shipped bytes, "
+            "so echoes could not get fresh crop draws (use --wire raw "
+            "or --wire pack with echo)")
+
+
 def _add_heartbeat_flags(p):
     """--heartbeat-dir / --lease-s / --heartbeat-interval: host-level
     fault domains (resilience/heartbeat.py). Passing --heartbeat-dir
@@ -1104,6 +1204,7 @@ def main(argv=None):
                    help=">0: also roll back when the loss exceeds this "
                         "factor times its recent healthy EMA")
     _add_perf_flags(t)
+    _add_feed_flags(t)
     t.add_argument("--chaos", metavar="SPEC",
                    help="deterministic fault injection, e.g. "
                         "'nan_step=30,io_p=0.02,sigterm_round=3,seed=1' "
@@ -1245,6 +1346,7 @@ def main(argv=None):
                         "'kill_worker=1,kill_round=3' to crash a worker "
                         "mid-run; also via SPARKNET_CHAOS)")
     _add_perf_flags(c)
+    _add_feed_flags(c)
     _add_health_flags(c)
     _add_elastic_flags(c)
     _add_heartbeat_flags(c)

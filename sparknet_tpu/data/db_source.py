@@ -84,6 +84,19 @@ class DatumBatchSource:
             else (1, 1, int(arr.size))
         self.shape = (self.batch_size,) + \
             self.transformer.output_shape(self.record_shape)
+        # optional compressed wire format (device mode only): host-side
+        # pre-crop and/or lossless bit-pack per SPARKNET_WIRE, decoded by
+        # a wrapped device_fn (data/wire.py). raw mode = no codec = the
+        # previous feed byte for byte.
+        self._codec = None
+        if self.device_mode:
+            from .wire import WireCodec, wire_mode_from_env, \
+                wire_bits_from_env
+            mode = wire_mode_from_env()
+            if mode != "raw":
+                self._codec = WireCodec(
+                    self._devt, self.record_shape, mode=mode,
+                    bits=wire_bits_from_env(), sample=arr)
 
     @property
     def num_records(self):
@@ -136,20 +149,36 @@ class DatumBatchSource:
                 arrs.append(arr.reshape(c, h, w))
             batch = np.stack(arrs)  # uint8, or float32 for float_data nets
             if self.device_mode:
-                yield {self.data_top: batch, self.label_top: labels,
+                out = {self.data_top: batch, self.label_top: labels,
                        **self._devt.aux(self.batch_size, self.record_shape)}
+                yield self._codec.encode(out) if self._codec else out
             else:
                 yield {self.data_top: self.transformer(batch),
                        self.label_top: labels}
 
+    def fresh_aux(self):
+        """New host-side crop/mirror draws for one batch (data echoing:
+        each echo of a transferred batch gets distinct augmentation)."""
+        return self._devt.aux(self.batch_size, self.record_shape)
+
+    @property
+    def wire(self):
+        """The active WireCodec, or None (raw wire / host mode)."""
+        return self._codec
+
     @property
     def device_fn(self):
-        """Jittable on-device transform (device mode only)."""
+        """Jittable on-device transform (device mode only), wire-aware."""
+        if self._codec is not None:
+            return self._codec.device_fn()
         return self._devt.device_fn()
 
     @property
     def raw_feed_overrides(self):
-        """check_batch shape overrides for the raw feed (device mode)."""
+        """check_batch shape overrides for the raw feed (device mode),
+        reflecting the SHIPPED wire shapes when a codec is active."""
+        if self._codec is not None:
+            return self._codec.raw_overrides(self.batch_size)
         return self._devt.raw_overrides(self.batch_size, self.record_shape)
 
     def close(self):

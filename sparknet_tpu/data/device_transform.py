@@ -86,11 +86,19 @@ class DeviceTransformer:
         return over
 
     # -- device side -------------------------------------------------------
-    def device_fn(self):
+    def device_fn(self, precropped=False):
         """-> pure fn(batch dict) -> batch dict, jit-traceable and
         shape-polymorphic over the batch dim (works under shard_map slices
         and lax.scan micro-batches). Consumes ``data_top`` (+ aux keys),
-        passes every other entry (labels, extra feeds) through."""
+        passes every other entry (labels, extra feeds) through.
+
+        ``precropped``: the wire codec already sliced the crop window from
+        the uint8 source on the host (data/wire.py), so skip the crop
+        gather — but still consume the y/x aux to slice the full-size mean
+        at the ORIGINAL source coordinates, keeping the float32 op order
+        (and output bits) identical to the uncropped path: slicing uint8
+        then casting equals casting then slicing.
+        """
         t = self.h
         crop = t.crop_size
         scale = t.scale
@@ -108,9 +116,11 @@ class DeviceTransformer:
                 ys = batch.pop(ky)
                 xs = batch.pop(kx)
 
-                def win(img, y, x0):
-                    return lax.dynamic_slice(img, (0, y, x0), (c, crop, crop))
-                out = jax.vmap(win)(out, ys, xs)
+                if not precropped:
+                    def win(img, y, x0):
+                        return lax.dynamic_slice(img, (0, y, x0),
+                                                 (c, crop, crop))
+                    out = jax.vmap(win)(out, ys, xs)
                 if mean is not None and full_mean:
                     # source-indexed mean window, subtracted pre-mirror
                     out = out - jax.vmap(

@@ -55,6 +55,10 @@ EVENTS = {
         "fields": ['hosts', 'observer', 'orphaned_files'],
         "open": False,
     },
+    'h2d_stage': {
+        "fields": ['bytes', 'dispatch_ms', 'in_flight', 'kb_per_item', 'name', 'puts', 'slots', 'wait_ms'],
+        "open": False,
+    },
     'hbm': {
         "fields": ['iter'],
         "open": True,
@@ -81,6 +85,10 @@ EVENTS = {
     },
     'host_round': {
         "fields": ['arrived', 'dead', 'lease_age_s', 'observer', 'round', 'wait_s'],
+        "open": False,
+    },
+    'ingest': {
+        "fields": ['hi', 'host', 'hosts', 'kind', 'lo', 'partitions', 'reads', 'records'],
         "open": False,
     },
     'membership': {

@@ -78,6 +78,11 @@ class MonitorState:
         self.coordinated_restart = None
         # elastic world resizing (resilience/checkpoint.py reshard)
         self.reshard = None         # last reshard event, if any
+        # input pipeline (data/prefetch.py, data/ingest.py, ISSUE 13)
+        self.prefetch = None        # last prefetch gauge event
+        self.h2d_stage = None       # last h2d_stage event
+        self.ingest_hosts = {}      # host -> last ingest event
+        self.ingest_respreads = 0
         # serving tier (serve/server.py, ISSUE 11)
         self.serve_requests = 0
         self.serve_rows = 0
@@ -190,6 +195,15 @@ class MonitorState:
             self.last_host_join = ev
         elif kind == "reshard":
             self.reshard = ev
+        elif kind == "prefetch":
+            self.prefetch = ev
+        elif kind == "h2d_stage":
+            self.h2d_stage = ev
+        elif kind == "ingest":
+            if ev.get("host") is not None:
+                self.ingest_hosts[int(ev["host"])] = ev
+            if ev.get("kind") == "respread":
+                self.ingest_respreads += 1
         elif kind == "serve_request":
             self.serve_requests += 1
             if _num(ev.get("rows")):
@@ -399,6 +413,35 @@ class MonitorState:
                      f"{_fmt_bytes(self.comms['collective_bytes_per_step'])}"
                      "/step collective, h2d total "
                      f"{_fmt_bytes(self.comms.get('h2d_bytes_total'))}")
+        if self.prefetch or self.h2d_stage or self.ingest_hosts:
+            bits = []
+            pf = self.prefetch or {}
+            if pf.get("name"):
+                bits.append(f"{pf['name']}")
+            if _num(pf.get("echo")) and pf["echo"] > 1:
+                bits.append(f"echo x{pf['echo']}")
+            if pf.get("wire") and pf.get("wire") != "raw":
+                bits.append(f"wire {pf['wire']}")
+            if _num(pf.get("h2d_kb_per_image")):
+                bits.append(f"{pf['h2d_kb_per_image']} KB/img")
+            st = self.h2d_stage
+            if st:
+                bits.append(f"staged {st.get('puts', 0)} "
+                            f"({st.get('kb_per_item', '?')} KB/item, "
+                            f"wait {st.get('wait_ms', '?')} ms, "
+                            f"{st.get('in_flight', '?')}/"
+                            f"{st.get('slots', '?')} in flight)")
+            if self.ingest_hosts:
+                bits.append(f"ingest {len(self.ingest_hosts)} host(s)"
+                            + (f", {self.ingest_respreads} re-spread(s)"
+                               if self.ingest_respreads else ""))
+            if bits:
+                L.append("  feed: " + "  ".join(bits))
+            for h, e in sorted(self.ingest_hosts.items()):
+                rng = (f" [{e['lo']}..{e['hi']}]"
+                       if _num(e.get("lo")) and e["lo"] >= 0 else "")
+                L.append(f"    ingest host {h}: {e.get('records')} "
+                         f"record(s){rng}, {e.get('reads', 0)} read(s)")
         extras = []
         if self.recoveries:
             extras.append(f"recoveries {self.recoveries}")

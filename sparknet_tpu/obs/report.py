@@ -400,6 +400,26 @@ def aggregate(events):
         last = pf[-1]
         rep["prefetch"] = {k: v for k, v in last.items()
                            if k not in ("event", "t", "run")}
+    ing = [e for e in events if e.get("event") == "ingest"]
+    h2d = [e for e in events if e.get("event") == "h2d_stage"]
+    if ing or h2d:
+        ip = {}
+        if ing:
+            hosts = {}
+            for e in ing:
+                hosts[e.get("host", "?")] = {
+                    k: e.get(k) for k in
+                    ("hosts", "partitions", "records", "lo", "hi", "reads")}
+            ip["ingest"] = {
+                "hosts": hosts,
+                "respreads": sum(1 for e in ing
+                                 if e.get("kind") == "respread"),
+            }
+        if h2d:
+            last = h2d[-1]
+            ip["h2d_stage"] = {k: v for k, v in last.items()
+                               if k not in ("event", "t", "run")}
+        rep["input_pipeline"] = ip
     hbm = [e for e in events if e.get("event") == "hbm"]
     if hbm:
         peaks = [e.get("peak_bytes_in_use") or e.get("bytes_in_use") or 0
@@ -773,6 +793,30 @@ def render(rep):
         hdr("prefetch (last gauge)")
         for k, v in sorted(rep["prefetch"].items()):
             L.append(f"  {k} = {v}")
+    ip = rep.get("input_pipeline")
+    if ip:
+        hdr("input pipeline")
+        st = ip.get("h2d_stage")
+        if st:
+            L.append(f"  h2d staging: {st.get('puts', 0)} put(s), "
+                     f"{_fmt_bytes(st.get('bytes'))} shipped, "
+                     f"{st.get('kb_per_item', '?')} KB/item")
+            L.append(f"    dispatch {st.get('dispatch_ms', '?')} ms, "
+                     f"wait {st.get('wait_ms', '?')} ms, "
+                     f"in flight {st.get('in_flight', '?')}/"
+                     f"{st.get('slots', '?')} slot(s)")
+        ig = ip.get("ingest")
+        if ig:
+            hosts = ig.get("hosts", {})
+            L.append(f"  sharded ingest: {len(hosts)} host(s)"
+                     + (f", {ig['respreads']} re-spread(s)"
+                        if ig.get("respreads") else ""))
+            for h, d in sorted(hosts.items()):
+                rng = (f" [{d['lo']}..{d['hi']}]"
+                       if _num(d.get("lo")) and d["lo"] >= 0 else "")
+                L.append(f"    host {h}: partitions {d.get('partitions')}"
+                         f", {d.get('records')} record(s){rng}, "
+                         f"{d.get('reads', 0)} read(s)")
     if rep.get("hbm"):
         hdr("device memory")
         L.append(f"  peak bytes in use: "

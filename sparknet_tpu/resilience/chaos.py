@@ -68,6 +68,11 @@ subsystem claims to survive — on a schedule tests can replay exactly:
                    W's lag grows until it parks. The sync-vs-async
                    wall-clock gap under this injector IS the mode's
                    acceptance test (scripts/smoke.sh async stage).
+  slow_h2d=S       every host->device batch transfer costs S extra
+                   seconds (persistent; hooked by the feed path's
+                   H2DStager / round feed) — the artificially slow wire
+                   under which data echoing must win wall clock
+                   (scripts/smoke.sh ingest stage)
 
 Armed via `--chaos "nan_step=30,io_p=0.02,seed=1"` or the SPARKNET_CHAOS
 env var (same spec), which data sources and solvers pick up through
@@ -121,6 +126,7 @@ class ChaosMonkey:
                  slow_host=None, slow_host_s=0.0, slow_host_round=0,
                  slow_repeat=False,
                  slow_worker=None, slow_s=0.0, slow_round=0,
+                 slow_h2d=0.0,
                  seed=0, metrics=None, log_fn=print):
         self.nan_step = None if nan_step is None else int(nan_step)
         self.nan_repeat = bool(nan_repeat)
@@ -172,6 +178,9 @@ class ChaosMonkey:
         self.slow_round = int(slow_round)
         self._slow_worker_logged = False
         self._last_slow_worker = None
+        # the persistent slow H2D wire (feed-path staging / echo tests)
+        self.slow_h2d = float(slow_h2d)
+        self._slow_h2d_logged = False
         self._rng = np.random.RandomState(seed)
         self.metrics = metrics
         self.log = log_fn or (lambda *a: None)
@@ -201,6 +210,7 @@ class ChaosMonkey:
                  "slow_host": int, "slow_host_s": float,
                  "slow_host_round": int, "slow_repeat": truthy,
                  "slow_worker": int, "slow_s": float, "slow_round": int,
+                 "slow_h2d": float,
                  "seed": int}
         valid = f"valid injectors: {', '.join(sorted(known))}"
         fields = {}
@@ -456,3 +466,18 @@ class ChaosMonkey:
         last call, or None."""
         rep, self._last_slow_worker = self._last_slow_worker, None
         return rep
+
+    # -- the slow H2D wire (input-pipeline staging/echo) --------------------
+    def maybe_slow_h2d(self, nbytes=0):
+        """Delay the current host->device batch transfer by slow_h2d
+        seconds (persistent — every FRESH transfer pays; echoed batches
+        don't transfer, which is exactly the wall-clock edge the echo
+        smoke test asserts). Logs one chaos event on first activation."""
+        if self.slow_h2d <= 0:
+            return 0.0
+        if not self._slow_h2d_logged:
+            self._slow_h2d_logged = True
+            self._event("slow_h2d", seconds=self.slow_h2d,
+                        nbytes=int(nbytes))
+        time.sleep(self.slow_h2d)
+        return self.slow_h2d

@@ -1,0 +1,101 @@
+"""Per-host sharded ingest (data/ingest.py).
+
+One ownership rule: IngestShard maps record partitions to live hosts
+with the SAME sampler.partition_owners that drives elastic data
+re-spread, so these tests pin the properties the smoke stage asserts
+end to end — disjointness, coverage (including after an eviction
+re-spread), wrap-around reads confined to the owned set, and the
+closed `ingest` event stream.
+"""
+
+import numpy as np
+import pytest
+
+from sparknet_tpu.data.ingest import IngestShard
+from sparknet_tpu.data.sampler import partition_owners
+
+
+class _Sink:
+    def __init__(self):
+        self.events = []
+
+    def log(self, event, **kw):
+        self.events.append(dict(kw, event=event))
+
+
+def _union(shards):
+    return np.sort(np.concatenate([s.indices for s in shards]))
+
+
+@pytest.mark.parametrize("n,hosts", [(100, 2), (103, 4), (7, 3)])
+def test_disjoint_and_covering_all_alive(n, hosts):
+    shards = [IngestShard(n, h, hosts) for h in range(hosts)]
+    np.testing.assert_array_equal(_union(shards), np.arange(n))
+    assert sum(s.owned for s in shards) == n     # disjoint by counting
+    for s in shards:
+        assert s.partitions == [s.host]          # all alive: own partition
+
+
+def test_respread_after_eviction_covers_and_matches_owners():
+    n, hosts = 90, 3
+    shards = [IngestShard(n, h, hosts) for h in range(hosts)]
+    alive = np.array([True, False, True])
+    survivors = [shards[h].respread(alive) for h in (0, 2)]
+    # still a partition of the whole record space, no dead-host gap
+    np.testing.assert_array_equal(_union(survivors), np.arange(n))
+    owners = partition_owners(hosts, alive)
+    for s in survivors:
+        assert s.partitions == [p for p in range(hosts)
+                                if owners[p] == s.host]
+    # the dead host's shard contributes nothing and refuses reads
+    dead = shards[1].respread(alive)
+    assert dead.owned == 0
+    with pytest.raises(ValueError, match="owns no records"):
+        dead.take(0, 4)
+
+
+def test_readmission_respread_restores_initial_split():
+    n, hosts = 60, 2
+    s0 = IngestShard(n, 0, hosts)
+    grown = s0.respread([True, False]).respread([True, True])
+    np.testing.assert_array_equal(grown.indices, s0.indices)
+
+
+def test_take_wraps_within_owned_set():
+    n, hosts = 50, 2
+    s1 = IngestShard(n, 1, hosts)       # owns [25, 50)
+    idx = s1.take(start=20, count=12)   # wraps past the shard end
+    assert len(idx) == 12
+    assert idx.min() >= 25 and idx.max() < 50
+    assert 25 in idx                    # the wrap landed back at the base
+    # uniform coverage over exactly one lap
+    lap = s1.take(0, s1.owned)
+    np.testing.assert_array_equal(np.sort(lap), np.arange(25, 50))
+
+
+def test_ingest_events_init_read_respread():
+    ml = _Sink()
+    s = IngestShard(40, 0, 2, metrics=ml)
+    assert ml.events[0]["event"] == "ingest"
+    assert ml.events[0]["kind"] == "init"
+    assert ml.events[0]["records"] == s.owned == 20
+    idx = s.take(0, 5)                  # first read emits (1 % 25 == 1)
+    read = ml.events[-1]
+    assert read["kind"] == "read"
+    assert read["lo"] == idx.min() and read["hi"] == idx.max()
+    assert read["reads"] == 1
+    # throttling: the next emit waits for reads % emit_every == 1
+    for _ in range(10):
+        s.take(0, 5)
+    assert sum(e["kind"] == "read" for e in ml.events) == 1
+    for _ in range(15):                 # ...which lands at read 26
+        s.take(0, 5)
+    assert sum(e["kind"] == "read" for e in ml.events) == 2
+    s.respread([True, False])
+    assert ml.events[-1]["kind"] == "respread"
+    assert ml.events[-1]["records"] == 40    # sole survivor owns it all
+
+
+def test_describe_is_json_small():
+    d = IngestShard(33, 2, 4).describe()
+    assert d == {"host": 2, "hosts": 4, "partitions": 1, "records": 8}
