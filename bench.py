@@ -99,6 +99,23 @@ def _rate_stats(unit_per_window, dts):
             "max": round(rates[-1], 1), "windows": n}
 
 
+def _mem_cols(solver, batch):
+    """Peak-HBM columns from the compiled step's memory_analysis —
+    XLA's own accounting of what the step RESIDES in, per device (the
+    number that decides whether a model fits, where throughput only
+    says how fast it runs). Empty when the backend has no analysis."""
+    try:
+        ms = solver.compiled_memory_stats(batch)
+    except Exception:
+        return {}
+    if not ms:
+        return {}
+    mb = 1.0 / 2 ** 20
+    return {"peak_hbm_mb": round(ms["peak_bytes"] * mb, 2),
+            "hbm_argument_mb": round(ms["argument_bytes"] * mb, 2),
+            "hbm_temp_mb": round(ms["temp_bytes"] * mb, 2)}
+
+
 def _mk_solver(net_param, base_lr=0.01, compute_dtype=None):
     from sparknet_tpu.proto import Message
     from sparknet_tpu.solver.solver import Solver
@@ -324,6 +341,7 @@ def bench_transformer_lm(peak, seq_len=4096, batch=4, d_model=512,
                                                 dts),
            "train_kflops_per_token": round(flops / 1e3, 1),
            "model_tflops_per_sec": round(tok_s * flops / 1e12, 2)}
+    row.update(_mem_cols(solver, batch_d))
     if peak:
         row["mfu"] = round(tok_s * flops / peak, 4)
     return row
@@ -338,6 +356,11 @@ def bench_transformer_lm(peak, seq_len=4096, batch=4, d_model=512,
 # overhead), overlap -> data-parallel caffenet (the grad allreduce).
 # The input-pipeline levers (wire/staging/echo) A/B the HOST-FED feed
 # path instead of a compute trace — run_feed_ablation.
+# The sharding/precision levers (fsdp/tp/bf16) A/B the LM over the
+# device mesh: fsdp swaps DataParallelSolver for FSDPSolver (throughput
+# should hold, peak_hbm_mb is the payoff column), tp swaps in a
+# GSPMDSolver over the (data, model) mesh, bf16 flips
+# SPARKNET_PRECISION on a single-device LM.
 ABLATE_ENVS = {
     "epilogue": ("SPARKNET_EPILOGUE", "off", "on"),
     "scan": ("SPARKNET_SCAN", "off", "on"),
@@ -346,6 +369,9 @@ ABLATE_ENVS = {
     "wire": ("SPARKNET_WIRE", "raw", "precrop+pack"),
     "staging": ("SPARKNET_STAGING", "off", "on"),
     "echo": ("SPARKNET_ECHO", "1", "4"),
+    "fsdp": ("SPARKNET_FSDP", "off", "on"),
+    "tp": ("SPARKNET_TP", "1", "2"),
+    "bf16": ("SPARKNET_PRECISION", "fp32", "bf16"),
 }
 FEED_LEVERS = ("wire", "staging", "echo")
 
@@ -388,6 +414,44 @@ def run_ablation(lever, peak, emit):
                 vocab_size=vocab, seq_len=seq, batch_size=batch,
                 d_model=d, num_layers=nl, num_heads=8, flash=True),
                 compute_dtype=jnp.bfloat16)
+    elif lever in ("fsdp", "tp", "bf16"):
+        # the "one big model" lever set: same LM both arms, the env var
+        # picks the solver/precision. fsdp and tp need every device in
+        # the timed program, so batch rows must divide the mesh.
+        seq, d, nl, vocab, batch = (128, 64, 2, 256, 8) if tiny \
+            else (1024, 1024, 8, 8192, 8)
+        toks = rs.randint(0, vocab, (batch, seq))
+        batch_d = {"data": jnp.asarray(toks, jnp.int32),
+                   "label": jnp.asarray((toks + 1) % vocab, jnp.int32)}
+        unit, unit_key = batch * seq * ITERS, "tokens_per_sec"
+        fixed_flops = 3 * 2 * (nl * (12 * d ** 2 + seq * d) + d * vocab)
+        base = {"model": "transformer_lm", "batch": batch, "seq_len": seq,
+                "d_model": d, "num_layers": nl}
+
+        def mk():
+            from sparknet_tpu.proto import Message
+            net = zoo.transformer_lm(
+                vocab_size=vocab, seq_len=seq, batch_size=batch,
+                d_model=d, num_layers=nl, num_heads=8, flash=not tiny)
+            if lever == "bf16":
+                # compute_dtype=None -> CompiledNet resolves the
+                # SPARKNET_PRECISION env var: that resolution IS the arm
+                return _mk_solver(net)
+            sp = Message("SolverParameter", base_lr=0.01,
+                         lr_policy="fixed", momentum=0.9,
+                         weight_decay=0.0, display=0, random_seed=0)
+            if lever == "tp":
+                from sparknet_tpu.parallel import (GSPMDSolver,
+                                                   transformer_tp_rule)
+                from sparknet_tpu.parallel.mesh import make_tp_mesh
+                ways = int(os.environ.get("SPARKNET_TP", "1") or 1)
+                return GSPMDSolver(sp, mesh=make_tp_mesh(ways),
+                                   param_rule=transformer_tp_rule(ways),
+                                   net_param=net)
+            from sparknet_tpu.parallel import (DataParallelSolver,
+                                               FSDPSolver, fsdp_enabled)
+            cls = FSDPSolver if fsdp_enabled() else DataParallelSolver
+            return cls(sp, net_param=net)
     elif lever == "epilogue":
         batch, side, classes = (8, 32, 10) if tiny else (256, 224, 1000)
         batch_d = {"data": jnp.asarray(rs.randn(batch, 3, side, side),
@@ -435,7 +499,9 @@ def run_ablation(lever, peak, emit):
             for _ in range(WARMUP):     # first step traces under `val`
                 loss = s.train_step(batch_d)
             float(loss)
-            arms[arm] = (s, val)
+            # memory columns lower under the SAME env value the arm
+            # traced with (the knobs are read at trace time)
+            arms[arm] = (s, val, _mem_cols(s, batch_d))
         finally:
             os.environ.pop(env, None)
             if old is not None:
@@ -443,18 +509,18 @@ def run_ablation(lever, peak, emit):
 
     dts = {a: [] for a in arms}
     for _ in range(WINDOWS):
-        for a, (s, _v) in arms.items():
+        for a, (s, _v, _m) in arms.items():
             t0 = time.perf_counter()
             for _ in range(ITERS):
                 out = s.train_step(batch_d)
             float(out)
             dts[a].append(time.perf_counter() - t0)
 
-    for a, (s, val) in arms.items():
+    for a, (s, val, mem) in arms.items():
         flops = fixed_flops if fixed_flops is not None \
             else model_train_flops_per_image(s)
         rate = unit / min(dts[a])
-        row = dict(base, mode="ablation", ablation=lever, arm=a)
+        row = dict(base, mode="ablation", ablation=lever, arm=a, **mem)
         row[env] = val
         row[unit_key] = round(rate, 1)
         row[unit_key + "_spread"] = _rate_stats(unit, dts[a])

@@ -46,6 +46,14 @@ echo "serve smoke OK"
 bash scripts/smoke.sh ingest || exit 1
 echo "ingest smoke OK"
 
+# FSDP one-big-model, end to end: a d-small LM under --fsdp on
+# --precision bf16 whose metrics stream proves the sharded update
+# executed (fsdp kind=exec off the live arrays), SIGTERM + resume from
+# the gathered manifest, and the same checkpoint consumed by plain DP
+# (scripts/smoke.sh stage k)
+bash scripts/smoke.sh fsdp || exit 1
+echo "fsdp smoke OK"
+
 set -o pipefail
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu \

@@ -43,7 +43,18 @@
 #       clock — echoes reuse the transferred batch, so they skip the
 #       stall.
 #
-# Usage: smoke.sh [all|multihost|async|serve|ingest]  — the named
+# FSDP one-big-model (ISSUE 14):
+#   (k) a d-small transformer LM under --fsdp on --precision bf16 over
+#       the 8-virtual-device CPU mesh: the metrics stream must carry
+#       the fsdp kind=plan AND kind=exec events (exec measures the
+#       per-device resident bytes off the live arrays — proof the
+#       sharded update really executed), the run is SIGTERMed after its
+#       first committed snapshot and resumes from the gathered manifest
+#       with the iter counter continuing, and the same checkpoint
+#       restores into the replicated DP path (fsdp off) — the
+#       world-portable format.
+#
+# Usage: smoke.sh [all|multihost|async|serve|ingest|fsdp]  — the named
 # stages run alone (the fast CI wiring; scripts/ci.sh invokes them
 # individually).
 set -euo pipefail
@@ -615,6 +626,115 @@ EOF
          "${noecho_s}s)"
 }
 
+# --------------------------------------- FSDP one-big-model stage ----
+# Sharded training end to end (ISSUE 14): --fsdp on --precision bf16
+# on the 8-virtual-device CPU mesh. The exec event (logged after the
+# first train_step off the LIVE addressable shards, not the plan) is
+# the sharded-update-executed assertion; the kill/resume cycle proves
+# the gathered manifest round-trips, and the final leg restores the
+# same checkpoint into the replicated DP path — snapshots stay
+# world-portable across sharding modes.
+run_fsdp_stage() {
+    fz="$tmp/fsdp"
+    mkdir -p "$fz"
+    lm_args="--vocab 256 --seq-len 64 --batch 8 --d-model 64 --layers 2
+             --heads 4 --no-flash --display 5 --lr 0.01"
+
+    # long run, preempted: SIGTERM after the first committed snapshot
+    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python -m sparknet_tpu lm $lm_args --steps 100000 \
+        --fsdp on --precision bf16 \
+        --metrics "$fz/run1.jsonl" --snapshot-prefix "$fz/snap" \
+        --snapshot-every 10 > "$fz/run1.out" 2>&1 &
+    fpid=$!
+    python - "$fz" <<'EOF'
+from sparknet_tpu.resilience import checkpoint
+import sys
+entry = checkpoint.wait_for_manifest(sys.argv[1] + "/snap", timeout=300)
+assert entry is not None, "fsdp run never committed a snapshot"
+print(f"fsdp: gathered snapshot committed at iter {entry['iter']}")
+EOF
+    kill -TERM "$fpid" 2>/dev/null || true
+    wait "$fpid" || true
+
+    resume_iter=$(python -c "
+import json
+print(json.load(open('$fz/snap.latest.json'))['latest']['iter'])")
+    test "$resume_iter" -gt 0
+    state=$(python -c "
+import json
+print(json.load(open('$fz/snap.latest.json'))['latest']['state'])")
+
+    # the sharded update really executed, with bf16 mixed precision on
+    python - "$fz" <<'EOF'
+import json, sys
+evs = [json.loads(l) for l in open(sys.argv[1] + "/run1.jsonl")]
+cfg = next(e for e in evs if e["event"] == "config")
+assert cfg["fsdp"] == 1 and cfg["precision"] == "bf16", cfg
+fs = [e for e in evs if e.get("event") == "fsdp"]
+plan = [e for e in fs if e["kind"] == "plan"]
+ex = [e for e in fs if e["kind"] == "exec"]
+assert plan and plan[0]["world"] == 8, f"bad fsdp plan: {plan}"
+assert plan[0]["sharded_leaves"] > 0, plan
+assert plan[0]["hist_bytes_per_device"] \
+    < plan[0]["hist_bytes_replicated"], plan
+assert ex, "no fsdp exec event: the sharded update never ran"
+e = ex[0]
+assert e["param_bytes_per_device"] < e["param_bytes_replicated"], e
+print(f"fsdp: exec OK — {plan[0]['sharded_leaves']}/"
+      f"{plan[0]['total_leaves']} leaves sharded, "
+      f"{e['param_bytes_per_device']}/{e['param_bytes_replicated']} "
+      f"param bytes resident per device")
+EOF
+
+    # resume the SAME sharded mode from the gathered manifest
+    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python -m sparknet_tpu lm $lm_args --steps $((resume_iter + 10)) \
+        --fsdp on --precision bf16 --resume "$fz/$state" \
+        --metrics "$fz/run2.jsonl" > "$fz/run2.out" 2>&1 || {
+        echo "fsdp resume failed:"; cat "$fz/run2.out"; exit 1; }
+    grep -q "done: 10 steps" "$fz/run2.out"
+    python - "$fz" "$resume_iter" <<'EOF'
+import json, sys
+evs = [json.loads(l) for l in open(sys.argv[1] + "/run2.jsonl")]
+it0 = int(sys.argv[2])
+train = [e for e in evs if e["event"] == "train"]
+assert train and all(e["iter"] >= it0 for e in train), \
+    f"loss curve restarted below iter {it0}"
+assert any(e.get("event") == "fsdp" and e["kind"] == "exec"
+           for e in evs), "resumed run lost the sharded layout"
+print(f"fsdp: resume OK — curve continued from iter {it0}")
+EOF
+
+    # world-portability: the replicated DP path (fsdp off) consumes the
+    # same gathered checkpoint
+    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python -m sparknet_tpu lm $lm_args --steps $((resume_iter + 5)) \
+        --fsdp off --resume "$fz/$state" \
+        --metrics "$fz/run3.jsonl" > "$fz/run3.out" 2>&1 || {
+        echo "DP consume of fsdp snapshot failed:"
+        cat "$fz/run3.out"; exit 1; }
+    grep -q "done: 5 steps" "$fz/run3.out"
+    python - "$fz" <<'EOF'
+import json, sys
+evs = [json.loads(l) for l in open(sys.argv[1] + "/run3.jsonl")]
+assert not any(e.get("event") == "fsdp" for e in evs), \
+    "fsdp events in an fsdp=off run"
+cfg = next(e for e in evs if e["event"] == "config")
+assert cfg["fsdp"] == 0, cfg
+EOF
+    # the stream renders (fsdp is a registered event kind)
+    python -m sparknet_tpu report "$fz/run1.jsonl" > /dev/null
+    echo "fsdp stage OK: sharded update executed (exec event), SIGTERM" \
+         "snapshot resumed at iter $resume_iter, and the gathered" \
+         "checkpoint restored into plain DP"
+}
+
+if [ "$stage" = "fsdp" ]; then
+    run_fsdp_stage
+    echo "SMOKE OK (fsdp)"
+    exit 0
+fi
 if [ "$stage" = "ingest" ]; then
     run_ingest_stage
     echo "SMOKE OK (ingest)"
@@ -837,5 +957,7 @@ run_multihost_stage
 run_serve_stage
 
 run_ingest_stage
+
+run_fsdp_stage
 
 echo "SMOKE OK"

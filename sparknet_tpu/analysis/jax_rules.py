@@ -903,6 +903,10 @@ _COLLECTIVES = {"psum", "pmean", "pmax", "pmin", "all_gather",
                 "axis_index", "pswapaxes"}
 # which argument of each collective is the axis name
 _AXIS_ARG = {"axis_index": 0, "ppermute": 1, "pshuffle": 1}
+# collectives whose `axis=` KWARG is an array dimension, not the mesh
+# axis name (all_gather(x, axis_name, *, axis=0, tiled=...) and
+# friends) — the axis name is positional there, never that kwarg
+_DIM_AXIS_KWARG = {"all_gather", "all_to_all", "pswapaxes"}
 
 
 def _collective_axis_expr(call):
@@ -913,7 +917,8 @@ def _collective_axis_expr(call):
     if tail not in _COLLECTIVES:
         return None, None
     for kw in call.keywords:
-        if kw.arg in ("axis_name", "axis"):
+        if kw.arg == "axis_name" or (kw.arg == "axis"
+                                     and tail not in _DIM_AXIS_KWARG):
             return tail, kw.value
     pos = _AXIS_ARG.get(tail, 1)
     if len(call.args) > pos:

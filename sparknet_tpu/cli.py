@@ -604,9 +604,24 @@ def cmd_lm(args):
     # bf16 means MIXED precision: f32 master params (optimizer updates
     # would underflow in bf16 — a d=1024 Adam run measurably stalls at the
     # unigram plateau with bf16 masters), bf16 activations cast at the
-    # embedding so every matmul drives the MXU at full rate
+    # embedding so every matmul drives the MXU at full rate. --precision
+    # is the same policy through the SPARKNET_PRECISION env var (applied
+    # above), which CompiledNet resolves when compute_dtype is None.
+    import os as _os
     compute_dtype = jnp.bfloat16 if args.dtype == "bf16" else None
     dtype = jnp.float32
+    from .parallel.fsdp import fsdp_enabled
+    fsdp_on = fsdp_enabled()
+    tp_ways = int(_os.environ.get("SPARKNET_TP", "0") or 0)
+    if fsdp_on and tp_ways > 1:
+        raise SystemExit(
+            "--fsdp and --tp do not compose yet: FSDP shards over the "
+            "data axis via shard_map, TP annotates a (data, model) mesh "
+            "via GSPMD — pick one lever per run")
+    if (fsdp_on or tp_ways > 1) and (args.pipeline_stages > 1
+                                     or args.ep > 1 or args.sp > 1):
+        raise SystemExit("--fsdp/--tp compose with --dp only (not "
+                         "--ep/--sp/--pipeline-stages)")
     stream, floor = lm_batch_stream(args.vocab, args.batch, args.seq_len,
                                     seed=args.seed)
     if metrics:
@@ -614,11 +629,33 @@ def cmd_lm(args):
                     d_model=args.d_model, layers=args.layers,
                     seq_len=args.seq_len, batch=args.batch,
                     pipeline_stages=args.pipeline_stages,
-                    dtype=args.dtype)
+                    dtype=args.dtype,
+                    precision=_os.environ.get("SPARKNET_PRECISION",
+                                              "fp32") or "fp32",
+                    fsdp=int(fsdp_on), tp=max(tp_ways, 1))
     print(f"bigram corpus floor: {floor:.4f} nats/token "
           f"(untrained: {np.log(args.vocab):.4f})")
 
-    if args.ep > 1 or args.dp > 1 or args.sp > 1:
+    if tp_ways > 1:
+        # tensor parallelism: GSPMD annotations over a (data, model)
+        # mesh — wqkv/ffn1/lm_head column-split, wo/ffn2 row-split
+        # (parallel/gspmd.py transformer_tp_rule); the batch shards
+        # over whatever devices remain on "data"
+        from .parallel import GSPMDSolver, transformer_tp_rule
+        from .parallel.mesh import make_tp_mesh
+        from .models import zoo
+        net = zoo.transformer_lm(num_layers=args.layers, **lm_kw)
+        solver = GSPMDSolver(
+            sp, mesh=make_tp_mesh(tp_ways),
+            param_rule=transformer_tp_rule(tp_ways),
+            net_param=net, metrics=metrics, dtype=dtype,
+            compute_dtype=compute_dtype)
+        if args.resume:
+            solver.restore(args.resume)
+        start_iter = solver.iter
+        t0 = _time.time()
+        solver.step(args.steps - solver.iter, iter(stream))
+    elif args.ep > 1 or args.dp > 1 or args.sp > 1 or fsdp_on:
         # mesh-axis solvers: --ep (x --dp x --sp) -> ExpertParallelSolver
         # (expert weights + optimizer state sharded over "expert", batch
         # over data/expert, sequence over "seq" with ring attention);
@@ -656,9 +693,15 @@ def cmd_lm(args):
                 net_param=net, metrics=metrics, dtype=dtype,
                 compute_dtype=compute_dtype)
         else:
-            from .parallel import DataParallelSolver
-            solver = DataParallelSolver(
-                sp, mesh=make_mesh({"data": args.dp}), net_param=net,
+            # --dp alone (or --fsdp, which implies the data axis): the
+            # per-step allreduce family. FSDP swaps in the sharded-state
+            # twin — params + optimizer state dim0-sharded over "data",
+            # all-gather at use, reduce-scatter grads (parallel/fsdp.py)
+            from .parallel import DataParallelSolver, FSDPSolver
+            cls = FSDPSolver if fsdp_on else DataParallelSolver
+            dp_axes = {"data": args.dp if args.dp > 1 else -1}
+            solver = cls(
+                sp, mesh=make_mesh(dp_axes), net_param=net,
                 metrics=metrics, dtype=dtype,
                 compute_dtype=compute_dtype)
             import jax as _jax
@@ -889,6 +932,30 @@ def _add_perf_flags(p, scan=False):
                             "stacks: one traced body + lax.scan instead "
                             "of N unrolled copies (auto: TPU only). "
                             "Default: SPARKNET_SCAN env var, else auto")
+    p.add_argument("--precision", choices=("bf16", "fp32"), default=None,
+                   help="mixed-precision policy: bf16 activations with "
+                        "fp32 master weights + fp32 grad accumulation, "
+                        "or the untouched fp32 path. Default: "
+                        "SPARKNET_PRECISION env var, else fp32")
+
+
+def _add_sharding_flags(p):
+    """--fsdp / --tp: the one-big-model levers (parallel/fsdp.py,
+    parallel/gspmd.py). Same discipline as the perf flags: each writes
+    its SPARKNET_* env var before any solver is constructed."""
+    p.add_argument("--fsdp", choices=("on", "off"), default=None,
+                   help="ZeRO/FSDP sharding: params + optimizer state "
+                        "live dim0-sharded over the data axis "
+                        "(all-gather at use, reduce-scatter grads, "
+                        "per-shard update — bit-for-bit the replicated "
+                        "DP path at fp32). Default: SPARKNET_FSDP env "
+                        "var, else off")
+    p.add_argument("--tp", type=int, default=None, metavar="N",
+                   help="N>1: Megatron-style tensor parallelism for the "
+                        "LM's matmuls over an N-way \"model\" mesh axis "
+                        "(GSPMD annotations; remaining devices form the "
+                        "data axis). Default: SPARKNET_TP env var, "
+                        "else 1")
 
 
 def _apply_perf_flags(args):
@@ -897,6 +964,12 @@ def _apply_perf_flags(args):
         os.environ["SPARKNET_REMAT"] = args.remat
     if getattr(args, "scan", None) is not None:
         os.environ["SPARKNET_SCAN"] = args.scan
+    if getattr(args, "precision", None) is not None:
+        os.environ["SPARKNET_PRECISION"] = args.precision
+    if getattr(args, "fsdp", None) is not None:
+        os.environ["SPARKNET_FSDP"] = args.fsdp
+    if getattr(args, "tp", None) is not None:
+        os.environ["SPARKNET_TP"] = str(args.tp)
 
 
 def _add_feed_flags(p):
@@ -1386,6 +1459,7 @@ def main(argv=None):
                          "over a pipe mesh axis (PipelineLMSolver)")
     lm.add_argument("--microbatches", type=int, default=0)
     _add_perf_flags(lm, scan=True)
+    _add_sharding_flags(lm)
     lm.add_argument("--metrics", help="JSONL loss-curve output path")
     lm.add_argument("--snapshot-every", type=int, default=0)
     lm.add_argument("--snapshot-prefix")

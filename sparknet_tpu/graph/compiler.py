@@ -46,6 +46,20 @@ def _env_remat():
     return pol
 
 
+def _env_precision():
+    """SPARKNET_PRECISION -> compute dtype (the --precision CLI knob):
+    "bf16" runs activations in bfloat16 with fp32 master weights
+    (Micikevicius et al., 2018); "fp32"/unset is None — the untouched
+    full-precision path, bit for bit."""
+    import os
+    v = os.environ.get("SPARKNET_PRECISION", "").strip().lower()
+    if v in ("bf16", "bfloat16"):
+        return jnp.bfloat16
+    if v in ("", "fp32", "float32", "off"):
+        return None
+    raise ValueError(f"SPARKNET_PRECISION={v!r}: want bf16|fp32")
+
+
 def _checkpointed(fn, pol):
     """Wrap fn in jax.checkpoint under the named remat policy: "full"
     recomputes everything in the backward, "dots" saves matmul/conv
@@ -171,7 +185,11 @@ class CompiledNet:
         # so the cast only needs to happen where activations are BORN
         # from params alone — the embedding lookups (ops/dense.py Embed).
         # Float feeds choose their own dtype at the batch boundary.
-        self.compute_dtype = compute_dtype
+        # None defers to the SPARKNET_PRECISION env var (the --precision
+        # knob), resolved HERE so per-shard twin nets built from
+        # net.compute_dtype inherit the resolved policy.
+        self.compute_dtype = compute_dtype if compute_dtype is not None \
+            else _env_precision()
         self.net_param = filter_net(net_param, phase, level, stages)
         self.name = net_param.name
         feed_shapes = dict(feed_shapes or {})

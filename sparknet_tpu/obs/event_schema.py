@@ -36,7 +36,7 @@ EVENTS = {
         "open": True,
     },
     'config': {
-        "fields": ['batch', 'd_model', 'dtype', 'layers', 'loss_floor_nats', 'pipeline_stages', 'seq_len'],
+        "fields": ['batch', 'd_model', 'dtype', 'fsdp', 'layers', 'loss_floor_nats', 'pipeline_stages', 'precision', 'seq_len', 'tp'],
         "open": False,
     },
     'device_cache': {
@@ -50,6 +50,10 @@ EVENTS = {
     'eviction': {
         "fields": [],
         "open": True,
+    },
+    'fsdp': {
+        "fields": ['axis', 'hist_bytes_per_device', 'hist_bytes_replicated', 'iter', 'kind', 'min_size', 'param_bytes_per_device', 'param_bytes_replicated', 'sharded_leaves', 'total_leaves', 'world'],
+        "open": False,
     },
     'ghost_reaped': {
         "fields": ['hosts', 'observer', 'orphaned_files'],
@@ -193,6 +197,6 @@ EVENTS = {
     },
 }
 
-KINDS = ['abort', 'admission', 'coordinated_restart', 'killed', 'mesh_shrunk', 'nan', 'params', 'quorum_lost', 'recovery_armed', 'resume', 'rollback', 'serve', 'stall', 'summary', 'world_reset']
+KINDS = ['abort', 'admission', 'coordinated_restart', 'exec', 'killed', 'mesh_shrunk', 'nan', 'params', 'plan', 'quorum_lost', 'recovery_armed', 'resume', 'rollback', 'serve', 'stall', 'summary', 'world_reset']
 
 KINDS_OPEN = True

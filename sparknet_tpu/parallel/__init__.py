@@ -31,7 +31,9 @@ __all__ = [
     "init_runtime", "host_mesh", "auto_host_mesh", "survivor_mesh",
     "needs_host_relay", "local_batch_rows", "my_host_rows",
     "DataParallelSolver", "LocalSGDSolver", "shard_batch",
-    "GSPMDSolver", "default_param_rule", "SeqParallelSolver",
+    "FSDPSolver", "fsdp_enabled", "plan_param_specs",
+    "GSPMDSolver", "default_param_rule", "transformer_tp_rule",
+    "SeqParallelSolver",
     "ExpertParallelSolver",
     "ring_attention", "ulysses_attention", "sequence_sharded_apply",
     "gpipe", "pipeline_apply", "stack_params", "PipelineLMSolver",
@@ -53,7 +55,10 @@ _EXPORTS = {
     "my_host_rows": "multihost",
     "DataParallelSolver": "data_parallel", "LocalSGDSolver": "data_parallel",
     "shard_batch": "data_parallel",
+    "FSDPSolver": "fsdp", "fsdp_enabled": "fsdp",
+    "plan_param_specs": "fsdp",
     "GSPMDSolver": "gspmd", "default_param_rule": "gspmd",
+    "transformer_tp_rule": "gspmd",
     "SeqParallelSolver": "seq_parallel",
     "ExpertParallelSolver": "expert_parallel",
     "ring_attention": "ring", "ulysses_attention": "ring",

@@ -23,6 +23,7 @@ import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..solver.solver import Solver
+from ..solver.updates import accum_init, accum_add
 from ..obs.divergence import (tree_sq_dist, _sq_sum,
                               gather_worker_scalar)
 from ..resilience.elastic import (masked_consensus, masked_consensus_stats,
@@ -280,11 +281,12 @@ class DataParallelSolver(Solver):
                     acc, state, i = carry
                     loss, g, state = one_grad(
                         params, state, micro, jax.random.fold_in(rng, i))
-                    acc = jax.tree_util.tree_map(jnp.add, acc, g)
-                    return (acc, state, i + 1), loss
-                zero = jax.tree_util.tree_map(jnp.zeros_like, params)
+                    # fp32 accumulation regardless of param dtype (the
+                    # mixed-precision contract; bitwise the old
+                    # zeros_like path for fp32 params)
+                    return (accum_add(acc, g), state, i + 1), loss
                 (grads, state, _), losses = jax.lax.scan(
-                    body, (zero, state, 0), batch)
+                    body, (accum_init(params), state, 0), batch)
                 loss = jnp.mean(losses)
             # validity: the host-declared alive bit AND (with elasticity
             # armed) the on-device finite check — a NaN'd shard can't
@@ -359,6 +361,20 @@ class DataParallelSolver(Solver):
     def _build_train_step(self):
         # built lazily on first batch (need shapes for specs)
         return None
+
+    def _memory_step_fn(self, batch):
+        if self._jit_train is None:
+            self._jit_train = self._sharded_step(
+                {k: np.asarray(v) for k, v in batch.items()})
+        return self._jit_train
+
+    def _memory_step_args(self, batch):
+        dev_batch = shard_batch(
+            batch, self.mesh, self.axis,
+            batch_dim=0 if int(self.param.iter_size) == 1 else 1)
+        return (self.params, self.state, self.history, dev_batch,
+                jnp.asarray(self.iter, jnp.int32), self.rng,
+                self._alive_mask(), self._staleness_lag())
 
     def _register_comms(self, cm):
         """Per-step DP sync: the grads+state pmean over the data axis —

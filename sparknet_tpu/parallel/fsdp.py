@@ -1,0 +1,430 @@
+"""ZeRO/FSDP sharded data parallelism: one big model over the data axis.
+
+`DataParallelSolver` replicates params + optimizer state on every device,
+so model scale is capped by one chip's HBM and remat is the only pressure
+valve. `FSDPSolver` removes the cap the ZeRO way (Rajbhandari et al.,
+2020, stage 3 for params + stage 1/2 for grads/optimizer state):
+
+  * every eligible weight blob lives dim0-SHARDED across the "data" axis
+    (each device owns rows [w*d0/n, (w+1)*d0/n)); optimizer history
+    shards identically, so per-device residency for params + Adam state
+    drops from (1 + n_hist) * P to (1 + n_hist) * P / n;
+  * the forward/backward needs full weights, so the step all-gathers
+    them at use (`gather_full`) — a transient that XLA frees after the
+    last consumer, never a resident replica;
+  * the gradient consensus becomes a reduce-scatter (`scatter_grads`):
+    each device receives only the mean of ITS shard's rows, paying
+    (n-1)/n * B on the wire where DP's allreduce pays 2(n-1)/n * B;
+  * the optimizer update runs elementwise on each device's own shard —
+    the update FLOPs and memory also divide by n.
+
+Collectives are issued per reverse-order bucket (`overlap.plan_buckets`,
+the same plan the DP allreduce overlaps with): deep layers' grads finish
+backward first, so their scatters start while shallow layers still
+differentiate, and the per-bucket concatenation amortizes ring latency.
+
+Numerics contract (tests/test_fsdp.py): psum_scatter/n is bitwise the
+pmean each DP device computes (same per-element additions in the same
+ring order), and the sharded elementwise update on shard rows is the
+same arithmetic the replicated update does on those rows — so fsdp=on
+at fp32 is BIT-FOR-BIT fsdp=off, and fsdp=off is untouched code.
+
+Sharding is an implementation detail of the STEP: params/history enter
+and leave the jit as global jax.Arrays with their full logical shape
+(NamedSharding over the mesh, 1/n of the bytes per device), so the tree
+view, `np.asarray` snapshot gathers, eval (which auto-reshards the
+params into its replicated specs), and the manifest format are all
+unchanged. Elastic membership and bounded staleness are REFUSED: a dead
+worker's param shard is unrecoverable mid-step, so FSDP's failure story
+is the checkpoint/restore path, not the masked consensus.
+"""
+
+import os
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..resilience.elastic import (masked_consensus, masked_consensus_stats,
+                                  masked_scalar_mean)
+from ..obs.divergence import _sq_sum, gather_worker_scalar
+from ..solver.updates import accum_init, accum_add, apply_clip
+from .mesh import DATA_AXIS
+from . import context
+from .compat import shard_map
+from .data_parallel import (DataParallelSolver, _batch_specs, place_tree)
+from .overlap import plan_buckets
+
+
+def fsdp_enabled(default=False):
+    """SPARKNET_FSDP=on|off — shard params + optimizer state over the
+    data axis (default off: the replicated DP path, untouched)."""
+    v = os.environ.get("SPARKNET_FSDP", "").strip().lower()
+    if not v:
+        return default
+    return v in ("1", "on", "true", "yes")
+
+
+def fsdp_min_size(default=2048):
+    """SPARKNET_FSDP_MIN_SIZE — smallest element count worth sharding;
+    blobs under it stay replicated (a 1-element collective costs more
+    latency than its bytes save)."""
+    v = os.environ.get("SPARKNET_FSDP_MIN_SIZE", "").strip()
+    return int(v) if v else default
+
+
+def plan_param_specs(tree, n, axis=DATA_AXIS, min_size=None):
+    """Per-leaf sharding decision for params (or their congruent
+    optimizer history): dim0-shard any leaf whose leading dim divides
+    the axis size and whose element count clears ``min_size``;
+    everything else stays replicated. Returns a tree of PartitionSpecs
+    congruent with ``tree`` (P(axis) = dim0-sharded, P() = replicated)."""
+    if min_size is None:
+        min_size = fsdp_min_size()
+
+    def spec(x):
+        shape = tuple(np.shape(x))
+        if n > 1 and shape and shape[0] % n == 0 and \
+                int(np.prod(shape)) >= min_size:
+            return P(axis)
+        return P()
+
+    return jax.tree_util.tree_map(spec, tree)
+
+
+def _is_spec(s):
+    return isinstance(s, P)
+
+
+def _spec_leaves(specs):
+    return jax.tree_util.tree_flatten(specs, is_leaf=_is_spec)[0]
+
+
+def sharded_bytes(tree, specs, n):
+    """(per-device bytes, replicated-equivalent bytes) for ``tree``
+    placed per ``specs`` — the residency the fsdp obs event reports."""
+    per_dev = total = 0
+    for x, s in zip(jax.tree_util.tree_leaves(tree), _spec_leaves(specs)):
+        b = int(np.prod(np.shape(x))) * np.dtype(x.dtype).itemsize
+        total += b
+        per_dev += b // n if len(s) else b
+    return per_dev, total
+
+
+def gather_full(tree, specs, axis):
+    """All-gather the dim0-sharded leaves back to their full logical
+    shape (tiled: shard rows concatenate along dim 0 in axis-index
+    order, the exact inverse of the scatter); replicated leaves pass
+    through untouched. Issued leaf-by-leaf so XLA can schedule each
+    gather against the first op that consumes the weight."""
+
+    def one(s, x):
+        if len(s):
+            return jax.lax.all_gather(x, axis, axis=0, tiled=True)
+        return x
+
+    return jax.tree_util.tree_map(one, specs, tree, is_leaf=_is_spec)
+
+
+def take_shard(tree, specs, axis, n):
+    """Slice this device's own dim0 block out of FULL leaves — the
+    consensus-side twin of `gather_full`, used when a full consensus
+    already exists (the divergence-stats path): pmean-then-slice is
+    bitwise psum_scatter/n, so both grad paths land identical shards."""
+    w = jax.lax.axis_index(axis)
+
+    def one(s, x):
+        if not len(s):
+            return x
+        blk = x.shape[0] // n
+        return jax.lax.dynamic_slice_in_dim(x, w * blk, blk, 0)
+
+    return jax.tree_util.tree_map(one, specs, tree, is_leaf=_is_spec)
+
+
+def scatter_grads(grads, valid, axis, specs, n):
+    """The FSDP gradient consensus: dim0-sharded leaves reduce-scatter
+    (each device keeps the cross-worker mean of its own shard rows,
+    (n-1)/n * B on the wire vs the allreduce's 2(n-1)/n * B); replicated
+    leaves take the same masked pmean the DP path uses. Collectives are
+    issued per reverse-order bucket (`overlap.plan_buckets` — deep
+    layers first), each bucket's sharded leaves fused into ONE
+    psum_scatter payload; per-element additions are unchanged by the
+    concatenation, so the result is bitwise the per-leaf form."""
+    leaves, treedef = jax.tree_util.tree_flatten(grads)
+    sharded = [len(s) > 0 for s in _spec_leaves(specs)]
+    plan = plan_buckets(grads)
+    out = [None] * len(leaves)
+    for bucket in plan["buckets"]:
+        shard_ent = [e for e in bucket if sharded[e[0]]]
+        rep_ent = [e for e in bucket if not sharded[e[0]]]
+        if shard_ent:
+            bufs = [leaves[i].reshape(n, -1) for i, _, _, _ in shard_ent]
+            cols = [b.shape[1] for b in bufs]
+            ps = jax.lax.psum_scatter(
+                jnp.concatenate(bufs, axis=1), axis,
+                scatter_dimension=0, tiled=False)
+            off = 0
+            # static n as a same-dtype scalar: /n folds into the scatter
+            # epilogue and keeps the psum_scatter/n == pmean bit contract
+            inv = np.dtype(ps.dtype).type(n)
+            for (i, shape, _, _), c in zip(shard_ent, cols):
+                blk = (shape[0] // n,) + tuple(shape[1:])
+                out[i] = (ps[off:off + c] / inv).reshape(blk)
+                off += c
+        if rep_ent:
+            flat = jnp.concatenate(
+                [leaves[i].ravel() for i, _, _, _ in rep_ent])
+            flat, _ = masked_consensus(flat, valid, axis)
+            off = 0
+            for i, shape, _, size in rep_ent:
+                out[i] = flat[off:off + size].reshape(shape)
+                off += size
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def sharded_sq_norm(grads, specs, axis):
+    """Global squared L2 norm of a mixed shard/replicated gradient tree:
+    sharded leaves' partial sums psum over the axis (every device holds
+    disjoint rows), replicated leaves count once. Feeds the gradient
+    clip so `clip_gradients` semantics survive sharding (the norm is the
+    GLOBAL one, not the shard's)."""
+    shard_sq = jnp.zeros((), jnp.float32)
+    rep_sq = jnp.zeros((), jnp.float32)
+    for x, s in zip(jax.tree_util.tree_leaves(grads), _spec_leaves(specs)):
+        ss = jnp.sum(jnp.square(jnp.asarray(x, jnp.float32)))
+        if len(s):
+            shard_sq = shard_sq + ss
+        else:
+            rep_sq = rep_sq + ss
+    return jax.lax.psum(shard_sq, axis) + rep_sq
+
+
+class FSDPSolver(DataParallelSolver):
+    """DataParallelSolver whose params + optimizer history live sharded.
+
+    Same construction surface, same train_step/eval/snapshot/restore
+    surface; only the compiled step differs (gather-at-use /
+    reduce-scatter / sharded update). ``min_shard_size`` overrides
+    SPARKNET_FSDP_MIN_SIZE for tests."""
+
+    def __init__(self, solver_param, mesh=None, axis=DATA_AXIS,
+                 min_shard_size=None, **kw):
+        if kw.get("staleness") is not None:
+            raise ValueError(
+                "FSDP refuses bounded staleness: a lagging worker holds "
+                "the only copy of its param shard, so discounting it "
+                "corrupts the model instead of degrading gracefully")
+        super().__init__(solver_param, mesh=mesh, axis=axis, **kw)
+        n = self.mesh.shape[self.axis]
+        self._min_shard_size = min_shard_size
+        self.fsdp_specs = plan_param_specs(
+            self.params, n, self.axis, min_size=min_shard_size)
+        self.fsdp_hist_specs = plan_param_specs(
+            self.history, n, self.axis, min_size=min_shard_size)
+        self._place_sharded()
+        self._fsdp_logged = False
+        if self.metrics is not None:
+            sl = sum(len(s) > 0 for s in _spec_leaves(self.fsdp_specs))
+            nl = len(_spec_leaves(self.fsdp_specs))
+            pd, tot = sharded_bytes(self.params, self.fsdp_specs, n)
+            hd, htot = sharded_bytes(self.history, self.fsdp_hist_specs, n)
+            self.metrics.log(
+                "fsdp", kind="plan", axis=self.axis, world=n,
+                sharded_leaves=int(sl), total_leaves=int(nl),
+                param_bytes_per_device=int(pd),
+                param_bytes_replicated=int(tot),
+                hist_bytes_per_device=int(hd),
+                hist_bytes_replicated=int(htot),
+                min_size=int(min_shard_size if min_shard_size is not None
+                             else fsdp_min_size()))
+
+    # a dead worker's shard is unrecoverable mid-run: FSDP's failure
+    # story is snapshot/restore, never the masked consensus
+    def arm_elastic(self, *a, **kw):
+        raise ValueError(
+            "FSDP shards each param over the workers; evicting one "
+            "loses its shard. Use snapshots + restore (--resume auto) "
+            "for fault tolerance, or run elastic training with fsdp=off")
+
+    def arm_staleness(self, *a, **kw):
+        raise ValueError(
+            "FSDP refuses bounded staleness (sharded params cannot "
+            "tolerate a discounted worker); run with fsdp=off")
+
+    def _place_sharded(self):
+        """Pin params/history to their shard layout (1/n of the bytes
+        per device). Called at construction and after restore — the
+        boundaries where leaves are host/replicated arrays."""
+        self.params = place_tree(self.params, self.fsdp_specs, self.mesh)
+        self.history = place_tree(self.history, self.fsdp_hist_specs,
+                                  self.mesh)
+
+    def restore(self, state_path, reshard="strict"):
+        super().restore(state_path, reshard=reshard)
+        self._place_sharded()
+
+    def load_weights(self, caffemodel_path):
+        super().load_weights(caffemodel_path)
+        self.params = place_tree(self.params, self.fsdp_specs, self.mesh)
+
+    def _write_snapshot_files(self, *a, **kw):
+        # snapshots write the FULL logical tree. Single-process sharded
+        # jax.Arrays gather transparently under np.asarray; a data axis
+        # spanning processes needs the explicit replicate-gather first
+        # (each leaf is briefly full on every host — snapshot-time only)
+        if jax.process_count() > 1:
+            rep = NamedSharding(self.mesh, P())
+            params, history = self.params, self.history
+            g = jax.tree_util.tree_map(lambda x: jax.device_put(x, rep),
+                                       params)
+            h = jax.tree_util.tree_map(lambda x: jax.device_put(x, rep),
+                                       history)
+            self.params, self.history = g, h
+            try:
+                return super()._write_snapshot_files(*a, **kw)
+            finally:
+                self.params, self.history = params, history
+        return super()._write_snapshot_files(*a, **kw)
+
+    def train_step(self, batch):
+        out = super().train_step(batch)
+        if not self._fsdp_logged and self.metrics is not None:
+            # execution proof for the smoke/CI assertion: the params the
+            # STEP returned really are sharded (per-device resident bytes
+            # measured off the live arrays, not the plan)
+            self._fsdp_logged = True
+            per_dev = total = 0
+            for x in jax.tree_util.tree_leaves(self.params):
+                total += int(x.nbytes)
+                shards = getattr(x, "addressable_shards", None)
+                per_dev += int(shards[0].data.nbytes) if shards \
+                    else int(x.nbytes)
+            self.metrics.log(
+                "fsdp", kind="exec", axis=self.axis,
+                world=int(self.mesh.shape[self.axis]), iter=self.iter,
+                param_bytes_per_device=per_dev,
+                param_bytes_replicated=total)
+        return out
+
+    # -- compiled step -----------------------------------------------------
+    def _sharded_step(self, batch_example):
+        iter_size = int(self.param.iter_size)
+        net, updater, lr_fn = self.local_net, self.updater, self.lr_fn
+        axis = self.axis
+        n = self.mesh.shape[axis]
+        specs, hist_specs = self.fsdp_specs, self.fsdp_hist_specs
+        with_stats = self.stepstats is not None
+        loss_fn = self._wrapped_loss(net)
+
+        def one_grad(params, state, batch, rng):
+            def lf(p):
+                loss, (blobs, new_state) = loss_fn(p, state, batch, rng)
+                return loss, new_state
+            (loss, new_state), grads = jax.value_and_grad(
+                lf, has_aux=True)(params)
+            return loss, grads, new_state
+
+        clip_fn = None
+        if float(updater.clip) >= 0:
+            def clip_fn(grads):
+                return apply_clip(grads, float(updater.clip),
+                                  sharded_sq_norm(grads, specs, axis))
+
+        def step(params, state, history, batch, it, rng, alive, lag):
+            w = jax.lax.axis_index(axis)
+            valid = alive[w]
+            rng = jax.random.fold_in(rng, w)
+            # the all-gather: full weights exist only inside the step —
+            # XLA frees each one after its last forward/backward consumer
+            full = gather_full(params, specs, axis)
+            if iter_size == 1:
+                loss, grads, state = one_grad(full, state, batch, rng)
+            else:
+                def body(carry, micro):
+                    acc, state, i = carry
+                    loss, g, state = one_grad(
+                        full, state, micro, jax.random.fold_in(rng, i))
+                    return (accum_add(acc, g), state, i + 1), loss
+                (grads, state, _), losses = jax.lax.scan(
+                    body, (accum_init(full), state, 0), batch)
+                loss = jnp.mean(losses)
+            if with_stats:
+                # divergence stats need the full consensus anyway:
+                # reuse it and slice our shard (bitwise psum_scatter/n)
+                gfull, aux = masked_consensus_stats(grads, valid, axis)
+                aux["ref_sq"] = _sq_sum(gfull)
+                aux["worker_loss"] = gather_worker_scalar(loss, axis)
+                grads = take_shard(gfull, specs, axis, n)
+            else:
+                grads = scatter_grads(grads, valid, axis, specs, n)
+                aux = {}
+            loss = masked_scalar_mean(loss, valid, axis)
+            # BN running stats etc. stay replicated, same as DP
+            state, _ = masked_consensus(state, valid, axis)
+            # the sharded update: elementwise on this device's own rows
+            params, history = updater(params, grads, history, lr_fn(it),
+                                      it, clip_fn=clip_fn)
+            return params, state, history, loss, aux
+
+        bspec = _batch_specs(batch_example, axis,
+                             batch_dim=0 if iter_size == 1 else 1)
+        with context.axis_context(data=axis), \
+                context.world_context(axis=axis, size=n, elastic=False):
+            sharded = shard_map(
+                step, mesh=self.mesh,
+                in_specs=(specs, P(), hist_specs, bspec, P(), P(), P(), P()),
+                out_specs=(specs, P(), hist_specs, P(), P()),
+                check_vma=False)
+            return jax.jit(sharded, donate_argnums=(0, 1, 2))
+
+    def _register_comms(self, cm):
+        """FSDP per step: one all-gather of the sharded params (forward)
+        + a reduce-scatter of their grads (backward tail) + the plain
+        allreduce for whatever stayed replicated. Each leg moves
+        (n-1)/n * B per chip under the ring model — together the same
+        2(n-1)/n * B the DP allreduce moves, but the resident copy is
+        gone. Registered per reverse-order bucket like DP so `sparknet
+        report` decomposes overlapped vs exposed bytes."""
+        from ..obs.comms import (tree_bytes, ring_allreduce_bytes,
+                                 ring_reduce_scatter_bytes,
+                                 ring_all_gather_bytes)
+        from ..solver.solver import Solver
+        Solver._register_comms(self, cm)
+        n = self.mesh.shape[self.axis]
+        cm.set_topology(axes=dict(self.mesh.shape))
+        leaves = jax.tree_util.tree_leaves(self.params)
+        sharded = [len(s) > 0 for s in _spec_leaves(self.fsdp_specs)]
+        plan = plan_buckets(self.params)
+        sb = tree_bytes(self.state)
+        for bi, bucket in enumerate(plan["buckets"]):
+            shard_b = sum(sz * np.dtype(dt).itemsize
+                          for i, _, dt, sz in bucket if sharded[i])
+            rep_b = sum(sz * np.dtype(dt).itemsize
+                        for i, _, dt, sz in bucket if not sharded[i])
+            last = bi == len(plan["buckets"]) - 1
+            if shard_b:
+                cm.register(
+                    "fsdp_allgather_params",
+                    ring_all_gather_bytes(shard_b, n),
+                    axis=self.axis, bucket=bi, overlappable=True,
+                    note="param all-gather at use; hides under the "
+                         "previous layer's compute")
+                cm.register(
+                    "fsdp_reduce_scatter_grads",
+                    ring_reduce_scatter_bytes(shard_b, n),
+                    axis=self.axis, bucket=bi, overlappable=not last,
+                    note="grad reduce-scatter, issued as backward "
+                         "drains; ring model per chip")
+            if rep_b:
+                cm.register(
+                    "allreduce_grads_bucket",
+                    ring_allreduce_bytes(rep_b, n),
+                    axis=self.axis, bucket=bi, overlappable=not last,
+                    note="replicated-leaf grad pmean (blobs under the "
+                         "shard threshold)")
+        cm.register(
+            "allreduce_state", ring_allreduce_bytes(sb, n),
+            axis=self.axis,
+            note="pmean(state) per step, ring model per chip")

@@ -34,6 +34,49 @@ def default_param_rule(axis_size, min_size=2 ** 14):
     return rule
 
 
+def transformer_tp_rule(axis_size, axis=MODEL_AXIS):
+    """Megatron-style tensor parallelism for `zoo.transformer_lm` (and
+    `transformer_lm_pieces`) weight names — the opt-in "model" axis of
+    the FSDP/TP/precision lever set (SPARKNET_TP / `--tp`).
+
+    Column-split (output dim 0 over "model"): attn wqkv (+ bias), ffn1
+    (+ bias), lm_head (+ bias), and the vocab dim of the embedding
+    tables — each device computes its own slice of heads/hidden/logits.
+    Row-split (input dim 1 over "model"): attn wo and ffn2, whose
+    partial products XLA's SPMD partitioner completes with the psum the
+    explicit Megatron recipe writes by hand; their biases (added after
+    the reduce) stay replicated, as do the LayerNorms. A dim that does
+    not divide ``axis_size`` stays replicated rather than erroring —
+    the rule degrades blob-by-blob."""
+    def col(shape):
+        return shape and shape[0] % axis_size == 0
+
+    def row(shape):
+        return len(shape) == 2 and shape[1] % axis_size == 0
+
+    def rule(layer_name, idx, shape):
+        if axis_size <= 1:
+            return P()
+        base = layer_name.rsplit("/", 1)[-1]
+        if base == "attn":
+            # blobs: wqkv (3*inner, embed), bqkv (3*inner,),
+            #        wo (embed, inner), bo (embed,)
+            if idx in (0, 1) and col(shape):
+                return P(axis)
+            if idx == 2 and row(shape):
+                return P(None, axis)
+            return P()
+        if base in ("ffn1", "lm_head") and col(shape):
+            return P(axis)
+        if base == "ffn2" and idx == 0 and row(shape):
+            return P(None, axis)
+        if base in ("tok_embed", "pos_embed") and idx == 0 and \
+                len(shape) == 2 and col(shape):
+            return P(axis)
+        return P()
+    return rule
+
+
 class GSPMDSolver(Solver):
     """Solver whose compiled step carries sharding annotations.
 
@@ -104,6 +147,17 @@ class GSPMDSolver(Solver):
                 spec = P(batch_axes)
             out[k] = NamedSharding(self.mesh, spec)
         return out
+
+    def _memory_step_fn(self, batch):
+        # the annotated jit only exists after a first step traced the
+        # batch shardings; without one there is nothing to analyse
+        return getattr(self, "_jit", None)
+
+    def _memory_step_args(self, batch):
+        batch = {k: jax.device_put(np.asarray(v), self._batch_sh[k])
+                 for k, v in batch.items()}
+        return (self.params, self.state, self.history, batch,
+                jnp.asarray(self.iter, jnp.int32), self.rng)
 
     # -- compiled step -----------------------------------------------------
     def _build_train_step(self):

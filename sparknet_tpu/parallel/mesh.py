@@ -55,6 +55,17 @@ def mesh_axis_size(mesh, axis):
     return mesh.shape[axis] if axis in mesh.shape else 1
 
 
+def make_tp_mesh(tp, devices=None):
+    """The 2-D (data, model) mesh of the tensor-parallel lever
+    (`--tp N` / SPARKNET_TP): "model" gets ``tp`` devices (the
+    Megatron group — keep it inside one chip ring so the row-split
+    psums ride ICI), "data" the rest."""
+    tp = int(tp)
+    if tp < 1:
+        raise ValueError(f"need tp >= 1, got {tp}")
+    return make_mesh({DATA_AXIS: -1, MODEL_AXIS: tp}, devices=devices)
+
+
 def make_host_device_mesh(hosts=None, per_host=None, device_axis=DATA_AXIS,
                           devices=None):
     """Build the 2-D ``(host, device)`` mesh the hierarchical runtime

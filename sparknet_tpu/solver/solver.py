@@ -25,7 +25,7 @@ from ..proto import Message, text_format, wire
 from ..graph.compiler import CompiledNet, TRAIN, TEST, array_to_blob, \
     blob_to_array
 from .lr_policy import make_lr_fn
-from .updates import Updater, canonical_type
+from .updates import Updater, canonical_type, accum_init, accum_add
 
 
 def resolve_nets(sp, base_dir="", net_param=None):
@@ -263,6 +263,46 @@ class Solver:
     def _build_train_step(self):
         return jax.jit(self._train_step_fn(), donate_argnums=(0, 1, 2))
 
+    def _memory_step_fn(self, batch):
+        """The lowerable jit behind train_step (None when this solver
+        wraps its jit in a closure and no step has traced yet)."""
+        if self._jit_train is None:
+            self._jit_train = self._build_train_step()
+        return self._jit_train
+
+    def _memory_step_args(self, batch):
+        return (self.params, self.state, self.history, batch,
+                jnp.asarray(self.iter, jnp.int32), self.rng)
+
+    def compiled_memory_stats(self, batch):
+        """Per-device memory footprint of the COMPILED train step from
+        XLA's memory_analysis: argument/output/temp/aliased bytes plus
+        the peak-HBM proxy arg + out + temp - aliased (params, state
+        and history are donated, so their output copies alias the
+        inputs). This is the number that says whether a model FITS —
+        bench rows and the FSDP does-not-fit proof both read it. On
+        backends whose executable does not expose a memory analysis,
+        returns None. Lowering does not execute anything; the
+        persistent compile cache absorbs the second compile."""
+        batch = {k: jnp.asarray(v) for k, v in batch.items()}
+        fn = self._memory_step_fn(batch)
+        if fn is None or not hasattr(fn, "lower"):
+            return None
+        try:
+            ma = fn.lower(*self._memory_step_args(batch)) \
+                   .compile().memory_analysis()
+        except NotImplementedError:
+            return None
+        if ma is None:
+            return None
+        arg = int(ma.argument_size_in_bytes)
+        out = int(ma.output_size_in_bytes)
+        tmp = int(ma.temp_size_in_bytes)
+        ali = int(ma.alias_size_in_bytes)
+        return {"argument_bytes": arg, "output_bytes": out,
+                "temp_bytes": tmp, "alias_bytes": ali,
+                "peak_bytes": arg + out + tmp - ali}
+
     def _train_step_fn(self):
         """The pure (uncompiled) train step — subclasses re-jit it with
         sharding annotations (parallel.gspmd) or wrap it in shard_map."""
@@ -283,16 +323,17 @@ class Solver:
                 loss, grads, state = one_grad(params, state, batch, rng)
             else:
                 # batch leading axis = iter_size micro-batches; accumulate
-                # grads like reference solver.cpp:221-223 summing diffs.
+                # grads like reference solver.cpp:221-223 summing diffs —
+                # in fp32 regardless of param dtype (updates.accum_init,
+                # the mixed-precision contract; bitwise the old zeros_like
+                # path for fp32 params).
                 def body(carry, micro):
                     acc, state, i = carry
                     loss, g, state = one_grad(
                         params, state, micro, jax.random.fold_in(rng, i))
-                    acc = jax.tree_util.tree_map(jnp.add, acc, g)
-                    return (acc, state, i + 1), loss
-                zero = jax.tree_util.tree_map(jnp.zeros_like, params)
+                    return (accum_add(acc, g), state, i + 1), loss
                 (grads, state, _), losses = jax.lax.scan(
-                    body, (zero, state, 0), batch)
+                    body, (accum_init(params), state, 0), batch)
                 loss = jnp.mean(losses)
             rate = lr_fn(it)
             params, history = updater(params, grads, history, rate, it)

@@ -54,15 +54,43 @@ def init_history(solver_type, params):
         is_leaf=lambda x: hasattr(x, "shape"))
 
 
+def apply_clip(grads, clip, sumsq):
+    """Scale ``grads`` by clip/norm when the global L2 norm exceeds
+    ``clip``. Split out of `clip_gradients` so a sharded caller (FSDP)
+    can supply the DISTRIBUTED sumsq — shard leaves psum'd over the mesh
+    axis — and still get reference clip semantics on the global norm."""
+    norm = jnp.sqrt(sumsq)
+    scale = jnp.where(norm > clip, clip / jnp.maximum(norm, 1e-30), 1.0)
+    return jax.tree_util.tree_map(lambda g: g * scale, grads)
+
+
 def clip_gradients(grads, clip):
     """Global L2-norm clipping (sgd_solver.cpp:81-99); clip < 0 disables."""
     if clip is None or clip < 0:
         return grads
     leaves = jax.tree_util.tree_leaves(grads)
     sumsq = sum(jnp.sum(g.astype(jnp.float32) ** 2) for g in leaves)
-    norm = jnp.sqrt(sumsq)
-    scale = jnp.where(norm > clip, clip / jnp.maximum(norm, 1e-30), 1.0)
-    return jax.tree_util.tree_map(lambda g: g * scale, grads)
+    return apply_clip(grads, clip, sumsq)
+
+
+def accum_init(params):
+    """fp32 gradient accumulators for the iter_size micro-batch loop:
+    the mixed-precision contract (Micikevicius et al., 2018) sums
+    micro-grads in fp32 even when params or compute are bf16/fp16.
+    fp32 params already accumulate in fp32, so this is bit-for-bit the
+    old zeros_like path there."""
+    return jax.tree_util.tree_map(
+        lambda p: jnp.zeros(
+            p.shape,
+            jnp.float32 if jnp.issubdtype(p.dtype, jnp.floating)
+            and jnp.finfo(p.dtype).bits < 32 else p.dtype),
+        params)
+
+
+def accum_add(acc, g):
+    """acc + g in the accumulator's (>= fp32) dtype."""
+    return jax.tree_util.tree_map(
+        lambda a, x: a + x.astype(a.dtype), acc, g)
 
 
 def regularize(grad, param, wd_local, reg_type):
@@ -128,13 +156,17 @@ class Updater:
     def init(self, params):
         return init_history(self.solver_type, params)
 
-    def __call__(self, params, grads, history, rate, it):
+    def __call__(self, params, grads, history, rate, it, clip_fn=None):
         """One update: returns (new_params, new_history).
 
         ``rate`` is the policy lr for this iter; ``it`` the iter index
-        (both may be traced).
+        (both may be traced). ``clip_fn`` replaces the default global
+        L2 clip — a sharded solver passes one that computes the norm
+        over the whole mesh (see parallel/fsdp.py); None keeps the
+        reference `clip_gradients` path bit-for-bit.
         """
-        grads = clip_gradients(grads, self.clip)
+        grads = clip_fn(grads) if clip_fn is not None \
+            else clip_gradients(grads, self.clip)
         t = it + 1
         new_params, new_history = {}, {}
         for lname, blobs in params.items():

@@ -24,5 +24,14 @@ jax.config.update("jax_platforms", "cpu")
 REFERENCE = "/root/reference"
 
 
+def pytest_configure(config):
+    # tier-1 runs `-m 'not slow'`; slow covers the multi-GB big-model
+    # proofs (tests/test_fsdp.py::TestOneBigModel) that compile for
+    # minutes on a 1-core CI box
+    config.addinivalue_line(
+        "markers", "slow: multi-minute / multi-GB tests, excluded from "
+        "the tier-1 sweep")
+
+
 def reference_path(*parts):
     return os.path.join(REFERENCE, *parts)

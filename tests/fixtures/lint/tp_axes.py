@@ -1,0 +1,72 @@
+"""SPK104 fixture corpus — tensor-parallel axis helpers over the
+("data", "model") mesh (the parallel/fsdp.py + gspmd.py shapes).
+Parsed, never imported. Line numbers asserted in tests/test_lint.py."""
+
+import jax
+from jax.sharding import Mesh
+from jax.experimental.shard_map import shard_map
+
+
+def gather_full(tree, axis):
+    # axis-forwarding helper (fsdp.gather_full shape): the all-gather of
+    # dim0-sharded weights — callers are checked at their call site
+    return jax.tree_util.tree_map(
+        lambda x: jax.lax.all_gather(x, axis, axis=0, tiled=True), tree)
+
+
+def take_shard(tree, axis, n):
+    w = jax.lax.axis_index(axis)
+    return jax.tree_util.tree_map(
+        lambda x: jax.lax.dynamic_slice_in_dim(
+            x, w * (x.shape[0] // n), x.shape[0] // n), tree)
+
+
+def row_psum(y, axis):
+    # the Megatron row-split completion psum (gspmd row-parallel blobs)
+    return jax.lax.psum(y, axis)
+
+
+def wrong_model_on_data_mesh(devices):
+    mesh = Mesh(devices, ("data",))
+
+    def f(p):
+        return gather_full(p, "model")           # SPK104: no "model" axis
+
+    return shard_map(f, mesh=mesh, in_specs=None, out_specs=None)
+
+
+def wrong_axis_through_psum_helper(devices):
+    mesh = Mesh(devices, ("data", "model"))
+
+    def f(y):
+        return row_psum(y, "expert")             # SPK104 via helper
+
+    return shard_map(f, mesh=mesh, in_specs=None, out_specs=None)
+
+
+def wrong_axis_into_shard_index(devices):
+    mesh = Mesh(devices, ("data", "model"))
+
+    def f(p):
+        return take_shard(p, "pipe", 8)          # SPK104 via axis_index
+
+    return shard_map(f, mesh=mesh, in_specs=None, out_specs=None)
+
+
+def right_tp_axes(devices):
+    mesh = Mesh(devices, ("data", "model"))
+
+    def f(p, y):
+        full = gather_full(p, "data")
+        part = row_psum(y, "model")
+        return take_shard(full, "data", 8), part
+
+    return shard_map(f, mesh=mesh, in_specs=None, out_specs=None)
+
+
+def wrong_tp_suppressed(devices):
+    mesh = Mesh(devices, ("data", "model"))
+
+    def f(y):
+        return row_psum(y, "seq")  # spk: disable=SPK104
+    return shard_map(f, mesh=mesh, in_specs=None, out_specs=None)

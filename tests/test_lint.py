@@ -73,6 +73,17 @@ class TestJaxRuleCorpus:
             ("SPK104", 43),      # forwarded through masked_mean helper
         ])
 
+    def test_tp_axes_corpus(self):
+        # the tensor-parallel helper shapes (fsdp.gather_full forwards
+        # its axis to all_gather whose `axis=` kwarg is a DIMENSION —
+        # the summarizer must not mistake it for the axis name)
+        got = code_lines(fixture_findings("tp_axes.py"))
+        assert got == sorted([
+            ("SPK104", 33),      # "model" on a data-only mesh
+            ("SPK104", 42),      # bad axis through the row-psum helper
+            ("SPK104", 51),      # bad axis into axis_index via helper
+        ])
+
     def test_clean_fixture_is_clean(self):
         assert fixture_findings("clean.py") == []
 
@@ -96,8 +107,10 @@ class TestJaxRuleCorpus:
                  "build_update_suppressed", "host_driver", "split_ok",
                  "fold_in_loop_ok", "branch_ok", "rebind_ok",
                  "reuse_suppressed", "right_axes",
-                 "unresolvable_is_silent", "wrong_suppressed"}
-        for fname in ("jax_hazards.py", "prng.py", "axes.py"):
+                 "unresolvable_is_silent", "wrong_suppressed",
+                 "right_tp_axes", "wrong_tp_suppressed"}
+        for fname in ("jax_hazards.py", "prng.py", "axes.py",
+                      "tp_axes.py"):
             for f in fixture_findings(fname):
                 head = f.symbol.split(".")[0]
                 assert head not in quiet, f
@@ -383,8 +396,8 @@ class TestSelfLint:
         rule family, so a rule silently breaking shows up here."""
         codes = set()
         for fname in ("jax_hazards.py", "prng.py", "axes.py",
-                      "locks.py", "deadlock.py", "protocol.py",
-                      "events.py"):
+                      "tp_axes.py", "locks.py", "deadlock.py",
+                      "protocol.py", "events.py"):
             codes |= {f.code for f in fixture_findings(fname)}
         assert {"SPK101", "SPK102", "SPK103", "SPK104", "SPK105",
                 "SPK201", "SPK202", "SPK203", "SPK204",
