@@ -54,6 +54,13 @@ echo "ingest smoke OK"
 bash scripts/smoke.sh fsdp || exit 1
 echo "fsdp smoke OK"
 
+# fleet simulation, end to end: replay validation of a recorded real
+# multi-coordinator crash run must match membership-event-exactly,
+# then a 1,000-host x 200-round chaos cell under the 60 s CPU wall
+# budget with report/monitor rendering (scripts/smoke.sh stage l)
+bash scripts/smoke.sh simfleet || exit 1
+echo "simfleet smoke OK"
+
 set -o pipefail
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu \

@@ -54,9 +54,17 @@
 #       restores into the replicated DP path (fsdp off) — the
 #       world-portable format.
 #
-# Usage: smoke.sh [all|multihost|async|serve|ingest|fsdp]  — the named
-# stages run alone (the fast CI wiring; scripts/ci.sh invokes them
-# individually).
+# Fleet simulation (ISSUE 15):
+#   (l) replay validation — a recorded REAL multi-coordinator crash run
+#       (real threads, wall clock, on-disk rendezvous) must be
+#       reproduced membership-event-exactly by the discrete-event
+#       simulator — then a 1,000-host x 200-round chaos cell must
+#       finish under a 60 s CPU wall budget with `sparknet report` and
+#       `monitor` rendering the simulated metrics stream.
+#
+# Usage: smoke.sh [all|multihost|async|serve|ingest|fsdp|simfleet]  —
+# the named stages run alone (the fast CI wiring; scripts/ci.sh invokes
+# them individually).
 set -euo pipefail
 cd "$(dirname "$0")/.."
 export JAX_PLATFORMS=cpu
@@ -730,6 +738,62 @@ EOF
          "checkpoint restored into plain DP"
 }
 
+# --------------------------------------------- fleet simulation stage ----
+# Fleet-scale chaos simulation (ISSUE 15). First the replay gate: a
+# recorded REAL multi-coordinator crash run (real threads, real wall
+# clock, an on-disk rendezvous dir, the default seam) must be
+# reproduced membership-event-exactly by the simulator — a mismatch
+# means either the simulator drifted from the protocol or a protocol
+# change altered membership behavior unnoticed. Then the scale proof:
+# a 1,000-host x 200-round chaos cell (fail_rate failures + repair)
+# must finish under a 60 s CPU wall budget, and `sparknet report` /
+# `monitor` must render the simulated stream with zero special cases.
+run_simfleet_stage() {
+    sf="$tmp/sf"
+    mkdir -p "$sf"
+    python -m sparknet_tpu simfleet --record_real "$sf/rec.json" \
+        --hosts 3 --rounds 7 --interval 0.1 --lease 0.5 \
+        --round_s 0.12 --readmit_after 3 | tee "$sf/rec.out"
+    grep -q "membership events" "$sf/rec.out"
+    python -m sparknet_tpu simfleet --replay "$sf/rec.json" \
+        | tee "$sf/replay.out"
+    grep -q "REPLAY MATCH" "$sf/replay.out"
+
+    start=$(date +%s)
+    timeout -k 5 90 python -m sparknet_tpu simfleet \
+        --hosts 1000 --rounds 200 --interval 0.2 --lease 0.6 \
+        --round_s 0.15 --quorum 800 --recover_after 5 \
+        --chaos "fail_rate=0.0002,fail_seed=7" \
+        --metrics "$sf/fleet.jsonl" --json "$sf/fleet.json" \
+        | tee "$sf/fleet.out"
+    took=$(( $(date +%s) - start ))
+    test "$took" -le 60 || { echo "1000x200 cell took ${took}s (> 60s)"
+                             exit 1; }
+    grep -q "fleet: 1000 hosts x 200 rounds" "$sf/fleet.out"
+    python - "$sf" <<'EOF'
+import json, sys, os
+s = json.load(open(os.path.join(sys.argv[1], "fleet.json")))
+assert s["rounds"] == 200 and not s["quorum_lost"], s
+assert s["evictions"] > 0 and s["readmissions"] > 0, s
+print(f"sim cell OK: {s['evictions']} evictions, "
+      f"{s['readmissions']} readmissions, live {s['live_final']}/1000")
+EOF
+    python -m sparknet_tpu report "$sf/fleet.jsonl" | tee "$sf/rep.txt" \
+        > /dev/null
+    grep -q "fleet simulation" "$sf/rep.txt"
+    grep -q "1000 virtual hosts x 200 rounds" "$sf/rep.txt"
+    python -m sparknet_tpu monitor "$sf/fleet.jsonl" --once \
+        | tee "$sf/mon.txt" > /dev/null
+    grep -q "sim: 1000 hosts" "$sf/mon.txt"
+    echo "simfleet stage OK: real run replayed event-exactly," \
+         "1000x200 chaos cell in ${took}s, report+monitor rendered"
+}
+
+if [ "$stage" = "simfleet" ]; then
+    run_simfleet_stage
+    echo "SMOKE OK (simfleet)"
+    exit 0
+fi
 if [ "$stage" = "fsdp" ]; then
     run_fsdp_stage
     echo "SMOKE OK (fsdp)"
@@ -959,5 +1023,7 @@ run_serve_stage
 run_ingest_stage
 
 run_fsdp_stage
+
+run_simfleet_stage
 
 echo "SMOKE OK"

@@ -846,6 +846,105 @@ def cmd_monitor(args):
     return 0 if state.events else 2
 
 
+def cmd_simfleet(args):
+    """`sparknet simfleet`: the discrete-event fleet simulator
+    (sparknet_tpu.sim) — thousands of virtual hosts driving the REAL
+    heartbeat/consensus/elastic-policy code against a simulated clock
+    and in-memory rendezvous dir. One run, a --sweep grid, or the
+    replay-validation pair (--record_real / --replay). Exit 0 on
+    success, 1 on a replay mismatch, 2 on a bad chaos/sweep spec, 4
+    (EXIT_QUORUM_LOST) when the simulated fleet loses quorum — the
+    same exit a real run would take."""
+    import json as _json
+    import tempfile
+    from .utils.exit_codes import EXIT_QUORUM_LOST
+    from .utils.metrics import MetricsLogger
+    from .sim import FleetSim, replay, sweep
+
+    metrics = MetricsLogger(args.metrics) if args.metrics else None
+    log = print if args.verbose else None
+    try:
+        if args.record_real:
+            with tempfile.TemporaryDirectory() as d:
+                rec = replay.record_real(
+                    d, hosts=min(args.hosts, 4), rounds=args.rounds,
+                    interval_s=args.interval, lease_s=args.lease,
+                    round_s=args.round_s or 0.12,
+                    evict_after=args.evict_after,
+                    readmit_after=args.readmit_after,
+                    quorum=args.quorum, log_fn=log)
+            with open(args.record_real, "w") as f:
+                _json.dump(rec, f, indent=1)
+            print(f"simfleet: recorded real {rec['config']['hosts']}-"
+                  f"coordinator run -> {args.record_real} "
+                  f"({len(rec['sequence'])} membership events)")
+            return 0
+        if args.replay:
+            with open(args.replay) as f:
+                rec = _json.load(f)
+            ok, real_seq, sim_seq = replay.replay_sim(
+                rec, metrics=metrics, log_fn=log)
+            if ok:
+                print(f"simfleet: REPLAY MATCH — {len(sim_seq)} "
+                      "membership events reproduced exactly")
+                return 0
+            print("simfleet: REPLAY MISMATCH", file=sys.stderr)
+            print(f"  real: {real_seq}", file=sys.stderr)
+            print(f"  sim:  {sim_seq}", file=sys.stderr)
+            return 1
+        if args.sweep:
+            cells = []
+            for spec in args.sweep:
+                cells.extend(sweep.parse_grid(spec))
+            results = sweep.run_sweep(cells, metrics=metrics,
+                                      log_fn=print,
+                                      budget_s=args.budget_s)
+            print(sweep.render_table(results))
+            if args.json:
+                with open(args.json, "w") as f:
+                    _json.dump(results, f, indent=1)
+            return 0
+        sim = FleetSim(hosts=args.hosts, rounds=args.rounds,
+                       interval_s=args.interval, lease_s=args.lease,
+                       round_s=args.round_s, jitter=args.jitter,
+                       tau=args.tau, step_s=args.step_s,
+                       quorum=args.quorum, evict_after=args.evict_after,
+                       readmit_after=args.readmit_after,
+                       staleness=args.staleness, s_decay=args.s_decay,
+                       consensus=args.consensus,
+                       recover_after=args.recover_after,
+                       chaos=args.chaos, seed=args.seed,
+                       metrics=metrics, log_fn=log)
+        s = sim.run()
+        w = s["gate_wait_s"]
+        print(f"fleet: {s['hosts']} hosts x {s['rounds']} rounds "
+              f"(sim {s['sim_s']}s) consensus={s['consensus']} "
+              f"lease={s['lease_s']:g} interval={s['interval_s']:g} "
+              f"round_s={s['round_s']:g}")
+        print(f"membership: {s['evictions']} evictions, "
+              f"{s['readmissions']} readmissions, "
+              f"{s['admissions']} admissions; "
+              f"final live {s['live_final']}/{s['hosts']}"
+              + ("  QUORUM LOST" if s["quorum_lost"] else ""))
+        print(f"gate wait: mean {w['mean']}s p50 {w['p50']}s "
+              f"p95 {w['p95']}s max {w['max']}s")
+        print(f"staleness: parks {s['parks']} unparks {s['unparks']}"
+              + (f" max_lag {s['max_lag']}" if "max_lag" in s else "")
+              + f"  rollbacks {s['rollbacks']}"
+              + f"  retry_exhausted {s['retry_exhausted']}")
+        if args.json:
+            with open(args.json, "w") as f:
+                _json.dump(s, f, indent=1)
+        return EXIT_QUORUM_LOST if s["quorum_lost"] else 0
+    except ValueError as e:
+        # a typo'd chaos/sweep spec must fail loudly, not run vacuously
+        print(f"sparknet simfleet: error: {e}", file=sys.stderr)
+        return 2
+    finally:
+        if metrics is not None:
+            metrics.close()
+
+
 def cmd_serve(args):
     """`sparknet serve`: weights-only inference over a resilient
     checkpoint prefix — continuous batching, hot reload, graceful
@@ -1503,6 +1602,85 @@ def main(argv=None):
     mo.add_argument("--duration", type=float, default=None,
                     help="stop after this many seconds (default: forever)")
     mo.set_defaults(fn=cmd_monitor)
+
+    sf = sub.add_parser(
+        "simfleet",
+        help="discrete-event fleet simulator: thousands of virtual "
+             "hosts drive the real heartbeat/consensus/elastic-policy "
+             "code (simulated clock, in-memory rendezvous) — single "
+             "runs, --sweep grids, and replay validation against a "
+             "recorded real multi-coordinator run")
+    sf.add_argument("--hosts", type=int, default=64,
+                    help="virtual fleet size")
+    sf.add_argument("--rounds", type=int, default=50,
+                    help="simulated training rounds")
+    sf.add_argument("--interval", type=float, default=0.5,
+                    help="heartbeat interval_s, simulated seconds")
+    sf.add_argument("--lease", type=float, default=3.0,
+                    help="heartbeat lease_s, simulated seconds")
+    sf.add_argument("--round_s", type=float, default=None,
+                    help="simulated round duration (default: "
+                         "tau * step_s)")
+    sf.add_argument("--tau", type=int, default=4,
+                    help="local steps per consensus round (round_s = "
+                         "tau * step_s — sweeping tau changes how much "
+                         "compute amortizes each gate)")
+    sf.add_argument("--step_s", type=float, default=0.25,
+                    help="simulated seconds per local step")
+    sf.add_argument("--jitter", type=float, default=0.15,
+                    help="per-host round-duration jitter (std dev "
+                         "fraction, seeded)")
+    sf.add_argument("--quorum", type=int, default=1,
+                    help="ElasticPolicy quorum (exit 4 below it)")
+    sf.add_argument("--evict_after", type=int, default=1,
+                    help="ElasticPolicy evict_after")
+    sf.add_argument("--readmit_after", type=int, default=0,
+                    help="ElasticPolicy readmit cooldown (0 = never)")
+    sf.add_argument("--staleness", type=int, default=None,
+                    help="bounded-staleness s (parking past it)")
+    sf.add_argument("--s_decay", type=float, default=0.5,
+                    help="staleness consensus weight decay per lag")
+    sf.add_argument("--consensus",
+                    choices=("auto", "sync", "async", "none"),
+                    default="auto",
+                    help="cross-host transport: the real File/"
+                         "AsyncFileConsensus at small fleets, policy-"
+                         "level version clocks at scale (auto)")
+    sf.add_argument("--recover_after", type=int, default=0,
+                    help="revive chaos-killed hosts after this many "
+                         "rounds (0 = never) — the MTBF repair half")
+    sf.add_argument("--chaos",
+                    help="chaos spec, e.g. 'fail_rate=0.001,"
+                         "fail_seed=7,fail_corr=8' or 'kill_host=2,"
+                         "kill_host_round=5' (resilience/chaos.py)")
+    sf.add_argument("--seed", type=int, default=0,
+                    help="master seed: same spec + seed = same "
+                         "timeline, to the event")
+    sf.add_argument("--metrics",
+                    help="JSONL metrics output — the standard stream; "
+                         "renders through `sparknet report`/`monitor` "
+                         "unchanged")
+    sf.add_argument("--json", help="write the summary (or sweep "
+                                   "results) JSON here")
+    sf.add_argument("--sweep", action="append", metavar="GRID",
+                    help="axis grid 'hosts=200:1000,fail_rate="
+                         "0.0005:0.005' (Cartesian; repeatable — "
+                         "cells accumulate)")
+    sf.add_argument("--budget_s", type=float, default=None,
+                    help="real wall-clock budget for a sweep; unfired "
+                         "cells are reported, never silently dropped")
+    sf.add_argument("--record_real", metavar="OUT",
+                    help="run a REAL multi-coordinator SIGKILL-shaped "
+                         "scenario (threads + wall clock + on-disk "
+                         "rendezvous) and record its membership "
+                         "sequence to OUT for --replay")
+    sf.add_argument("--replay", metavar="REC",
+                    help="re-run a recording in the simulator; exit 1 "
+                         "unless the membership sequence matches "
+                         "exactly")
+    sf.add_argument("-v", "--verbose", action="store_true",
+                    help="log the simulated fleet's membership story")
+    sf.set_defaults(fn=cmd_simfleet)
 
     sv = sub.add_parser(
         "serve",

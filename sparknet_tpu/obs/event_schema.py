@@ -159,6 +159,10 @@ EVENTS = {
         "fields": ['batch_fill', 'batches', 'drained', 'latency_ms_p50', 'latency_ms_p95', 'latency_ms_p99', 'rejects', 'reloads', 'requests', 'rows', 'rps', 'uptime_s'],
         "open": False,
     },
+    'sim': {
+        "fields": ['admissions', 'dead', 'evictions', 'hosts', 'live', 'parked', 'readmissions', 'round', 't_s', 'wait_s'],
+        "open": False,
+    },
     'span': {
         "fields": [],
         "open": True,

@@ -76,6 +76,8 @@ class MonitorState:
         self.host_joins = collections.Counter()
         self.last_host_join = None
         self.coordinated_restart = None
+        # fleet simulation (sim/fleet.py, per-round summary)
+        self.sim = None             # last sim event
         # elastic world resizing (resilience/checkpoint.py reshard)
         self.reshard = None         # last reshard event, if any
         # input pipeline (data/prefetch.py, data/ingest.py, ISSUE 13)
@@ -193,6 +195,10 @@ class MonitorState:
                 self.host_joins[int(ev["host"])] += 1
                 self.host_alive[int(ev["host"])] = True
             self.last_host_join = ev
+        elif kind == "sim":
+            self.sim = ev
+            if _num(ev.get("round")):
+                self.round = ev["round"]
         elif kind == "reshard":
             self.reshard = ev
         elif kind == "prefetch":
@@ -352,6 +358,19 @@ class MonitorState:
                 L.append("    coordinated restart "
                          + ("AGREED" if cr.get("agreed") else "DISAGREED")
                          + f" across hosts {cr.get('hosts')}")
+        if self.sim is not None:
+            s = self.sim
+            bits = [f"{s.get('hosts')} hosts",
+                    f"round {s.get('round')}",
+                    f"live {s.get('live')}"]
+            if _num(s.get("parked")) and s["parked"]:
+                bits.append(f"parked {s['parked']}")
+            if _num(s.get("wait_s")):
+                bits.append(f"wait {s['wait_s']:.3f}s")
+            tot = [f"{k} {s[k]}" for k in
+                   ("evictions", "readmissions", "admissions")
+                   if _num(s.get(k)) and s[k]]
+            L.append("  sim: " + "  ".join(bits + tot))
         if self.serve_requests or self.serve_rejects or self.serve_summary:
             from .stepstats import percentiles
             bits = [f"requests {self.serve_requests}",

@@ -257,6 +257,25 @@ def aggregate(events):
                 if last.get("sha") else None,
                 "hosts": last.get("hosts")}
         rep["multihost"] = mh
+    # fleet simulation (sparknet_tpu/sim): the per-round closed summary
+    # a simulated fleet emits beside the standard host_* stream — fleet
+    # size, the live-count trajectory, and the gate-wait tail the
+    # lease/quorum sweeps tune against
+    sm = [e for e in events if e.get("event") == "sim"]
+    if sm:
+        waits = [e["wait_s"] for e in sm if _num(e.get("wait_s"))]
+        lives = [e["live"] for e in sm if _num(e.get("live"))]
+        fl = {"rounds": len(sm), "hosts": sm[-1].get("hosts"),
+              "sim_s": sm[-1].get("t_s"),
+              "live_final": lives[-1] if lives else None,
+              "live_min": min(lives) if lives else None,
+              "evictions": sm[-1].get("evictions"),
+              "readmissions": sm[-1].get("readmissions"),
+              "admissions": sm[-1].get("admissions"),
+              "parked_max": max((e.get("parked") or 0) for e in sm)}
+        fl.update({f"wait_s_{k}": round(v, 4)
+                   for k, v in percentiles(waits).items()})
+        rep["simulation"] = fl
     # bounded staleness (the async local-SGD mode): per-worker version
     # lag / park-time accounting + drift attribution
     st = [e for e in events if e.get("event") == "staleness"]
@@ -716,6 +735,23 @@ def render(rep):
                      f"{'AGREED' if cr.get('agreed') else 'DISAGREED'} "
                      f"on manifest {cr.get('sha')} across hosts "
                      f"{cr.get('hosts')}")
+    fl = rep.get("simulation")
+    if fl:
+        hdr("fleet simulation")
+        L.append(f"  {fl.get('hosts')} virtual hosts x "
+                 f"{fl.get('rounds')} rounds "
+                 f"({fl.get('sim_s')} simulated s)")
+        L.append(f"  live: min {fl.get('live_min')}, final "
+                 f"{fl.get('live_final')}; "
+                 f"{fl.get('evictions')} eviction(s), "
+                 f"{fl.get('readmissions')} readmission(s), "
+                 f"{fl.get('admissions')} admission(s), "
+                 f"peak parked {fl.get('parked_max')}")
+        ps = {q: fl.get(f"wait_s_{q}") for q in ("p50", "p95", "p99")}
+        if any(_num(v) for v in ps.values()):
+            L.append("  gate wait " + "  ".join(
+                f"{q}={ps[q]:.3f}s" for q in ("p50", "p95", "p99")
+                if _num(ps[q])))
     if any(rep.get(k) for k in ("divergence", "health", "memstats")):
         hdr("training health")
         d = rep.get("divergence")

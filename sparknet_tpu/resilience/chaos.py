@@ -73,6 +73,22 @@ subsystem claims to survive — on a schedule tests can replay exactly:
                    H2DStager / round feed) — the artificially slow wire
                    under which data echoing must win wall clock
                    (scripts/smoke.sh ingest stage)
+  fail_rate=P, fail_seed=S   every round, every live host independently
+                   crashes with probability P — the fleet-scale failure
+                   process (MTBF model) the simulator sweeps. The draw
+                   is a PER-ROUND derived rng (seeded from fail_seed and
+                   the round index), so the schedule is a pure function
+                   of (S, round): identical across replays and immune to
+                   how many other injectors consumed randomness. Victims
+                   stay down until explicitly revived (revive_host — the
+                   simulator's recovery process, or a policy
+                   readmission).
+  fail_corr=K      correlate the failures: hosts are grouped into
+                   failure domains of K consecutive ids (a rack, a
+                   zone), the per-round Bernoulli is drawn PER DOMAIN,
+                   and a failing domain takes all its hosts down
+                   together — the correlated-outage shape quorum
+                   settings must survive. K<=1 means independent hosts.
 
 Armed via `--chaos "nan_step=30,io_p=0.02,seed=1"` or the SPARKNET_CHAOS
 env var (same spec), which data sources and solvers pick up through
@@ -127,6 +143,7 @@ class ChaosMonkey:
                  slow_repeat=False,
                  slow_worker=None, slow_s=0.0, slow_round=0,
                  slow_h2d=0.0,
+                 fail_rate=0.0, fail_seed=0, fail_corr=0,
                  seed=0, metrics=None, log_fn=print):
         self.nan_step = None if nan_step is None else int(nan_step)
         self.nan_repeat = bool(nan_repeat)
@@ -181,6 +198,15 @@ class ChaosMonkey:
         # the persistent slow H2D wire (feed-path staging / echo tests)
         self.slow_h2d = float(slow_h2d)
         self._slow_h2d_logged = False
+        # the fleet-scale failure process (per-round iid or
+        # domain-correlated host crashes; resilience/README, sim/)
+        self.fail_rate = float(fail_rate)
+        if not 0.0 <= self.fail_rate <= 1.0:
+            raise ValueError(f"fail_rate {self.fail_rate} must be a "
+                             "probability in [0, 1]")
+        self.fail_seed = int(fail_seed)
+        self.fail_corr = max(0, int(fail_corr))
+        self._fail_dead = set()   # hosts fail_rate already took down
         self._rng = np.random.RandomState(seed)
         self.metrics = metrics
         self.log = log_fn or (lambda *a: None)
@@ -211,6 +237,7 @@ class ChaosMonkey:
                  "slow_host_round": int, "slow_repeat": truthy,
                  "slow_worker": int, "slow_s": float, "slow_round": int,
                  "slow_h2d": float,
+                 "fail_rate": float, "fail_seed": int, "fail_corr": int,
                  "seed": int}
         valid = f"valid injectors: {', '.join(sorted(known))}"
         fields = {}
@@ -316,6 +343,42 @@ class ChaosMonkey:
             os.kill(os.getpid(), signal.SIGTERM)
 
     # -- host-granularity injectors (fault domains) ------------------------
+    def fail_rate_victims(self, round_, n_hosts):
+        """Host ids the fail_rate process newly takes down at round
+        ``round_``. The Bernoulli draws come from a rng derived from
+        (fail_seed, round_) alone — a pure function of the schedule, so
+        replays and sweeps see identical failures no matter what other
+        injectors drew from the shared rng or how often this round was
+        polled. With fail_corr=K > 1 the draw is per failure DOMAIN of K
+        consecutive host ids and a failing domain dies as one."""
+        if self.fail_rate <= 0 or n_hosts <= 0:
+            return []
+        rng = np.random.RandomState(
+            (self.fail_seed * 1000003 + int(round_)) % (2 ** 32))
+        n_hosts = int(n_hosts)
+        corr = self.fail_corr if self.fail_corr > 1 else 1
+        n_domains = -(-n_hosts // corr)         # ceil
+        draws = rng.random_sample(n_domains)
+        out = []
+        for d in range(n_domains):
+            if draws[d] >= self.fail_rate:
+                continue
+            for h in range(d * corr, min((d + 1) * corr, n_hosts)):
+                if h not in self._fail_dead:
+                    self._fail_dead.add(h)
+                    out.append(h)
+        if out:
+            self._event("fail_rate", hosts=out, round=int(round_),
+                        corr=self.fail_corr)
+        return out
+
+    def revive_host(self, host):
+        """Forget a fail_rate/dead_p crash for ``host`` so the failure
+        process can take it down again — the simulator's (or an
+        autoscaler's) recovery half of the MTBF cycle."""
+        self._fail_dead.discard(int(host))
+        self._dead.discard(int(host))
+
     def dead_hosts(self, round_, n_hosts):
         """Host ids newly "crashed" at round ``round_`` — the virtual
         (single-process host mesh) rendering of kill_host, consumed by
@@ -337,6 +400,9 @@ class ChaosMonkey:
                             round=round_)
                 self._preempted_at = round_
                 out.append(self.preempt_host)
+        if not self.kill_host_self_mode:
+            out.extend(h for h in self.fail_rate_victims(round_, n_hosts)
+                       if h not in out)
         return out
 
     def rejoining_hosts(self, round_):

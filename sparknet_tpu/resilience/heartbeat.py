@@ -34,6 +34,29 @@ that channel, jax-free so it runs identically on any checkout:
                         EXIT_QUORUM_LOST (4), so a supervisor relaunch
                         resumes one consistent world.
 
+Time and storage go through the injectable seam (resilience/seam.py):
+``clock`` (wall stamps, MONOTONIC durations, sleep) and ``dirops``
+(atomic name-based file ops). The defaults are the process wall clock
+and the real directory — bit-identical production behavior — while the
+fleet simulator (sparknet_tpu/sim) injects a discrete-event clock and
+an in-memory directory and runs this exact code at 1,000 virtual
+hosts. Two time disciplines, deliberately split:
+
+  * durations and deadlines (lease ages, gate/consensus timeouts, the
+    startup grace) are computed on ``clock.monotonic()`` — an NTP step
+    or suspend/resume must never mass-expire every peer's lease;
+  * the stamps WRITTEN to disk stay wall-clock (human-readable, and
+    the only time base two processes on different machines share).
+    Cross-process stamp comparisons happen only where they must:
+    startup ghost reaping and late-joiner discovery, where the other
+    process may be long dead.
+
+Lease freshness bridges the two: a peer's age is measured monotonically
+from the moment THIS process last observed a new lease record (its
+seq/stamp advanced); the on-disk wall stamp only seeds the age the
+first time a pre-existing record is seen (a ghost's stale lease must
+still read as old).
+
 Rendezvous directory layout (one per run, on storage every host
 reaches — NFS/GCS-fuse on fleets, tmp dirs in tests):
 
@@ -57,26 +80,10 @@ import time
 import numpy as np
 
 from .checkpoint import atomic_write_bytes, atomic_write_json
+from .seam import WALL_CLOCK, RealDir
 
 # back-compat: this module's private writer predates the shared helper
 _atomic_write_json = atomic_write_json
-
-
-def fresh_leases(directory, lease_s, now=None):
-    """{host: lease record} for every UNEXPIRED hb-*.json lease in a
-    rendezvous dir — the running world a late joiner (`--grow`)
-    discovers before it has a coordinator of its own (it picks host id
-    max(existing)+1 and leases itself into the same directory)."""
-    now = time.time() if now is None else now
-    out = {}
-    for p in glob.glob(os.path.join(glob.escape(str(directory)),
-                                    "hb-*.json")):
-        rec = _read_json(p)
-        if rec is None or not isinstance(rec.get("host"), int):
-            continue
-        if now - float(rec.get("stamp", 0.0)) <= float(lease_s):
-            out[rec["host"]] = rec
-    return out
 
 
 def _read_json(path):
@@ -88,6 +95,37 @@ def _read_json(path):
     except (OSError, ValueError):
         return None
     return obj if isinstance(obj, dict) else None
+
+
+class _HeartbeatDir(RealDir):
+    """The default Dir seam, reading through this module's late-bound
+    ``_read_json`` so tests can inject torn/racy reads exactly as they
+    always have."""
+
+    def read_json(self, name):
+        return _read_json(self.path(name))
+
+
+def fresh_leases(directory, lease_s, now=None, dirops=None):
+    """{host: lease record} for every UNEXPIRED hb-*.json lease in a
+    rendezvous dir — the running world a late joiner (`--grow`)
+    discovers before it has a coordinator of its own (it picks host id
+    max(existing)+1 and leases itself into the same directory). Wall
+    stamps compared against wall ``now``: the prober has no receipt
+    history yet, and the leaseholders are other processes."""
+    now = time.time() if now is None else now
+    if dirops is None:
+        recs = (_read_json(p) for p in glob.glob(os.path.join(
+            glob.escape(str(directory)), "hb-*.json")))
+    else:
+        recs = (dirops.read_json(n) for n in dirops.glob("hb-*.json"))
+    out = {}
+    for rec in recs:
+        if rec is None or not isinstance(rec.get("host"), int):
+            continue
+        if now - float(rec.get("stamp", 0.0)) <= float(lease_s):
+            out[rec["host"]] = rec
+    return out
 
 
 class HostDead(RuntimeError):
@@ -111,20 +149,30 @@ class HeartbeatCoordinator:
     Thread contract: a background writer/monitor thread re-leases this
     host's heartbeat and refreshes the peer view while the training
     loop reads it; the mutable shared state (seq/round counters, the
-    published liveness view, the stop flag) is guarded by ``_lock``
-    (enforced by `sparknet lint` SPK201/202). Configuration fields
-    (dir/host/lease_s/...) are immutable after __init__; the world
-    size ``n`` is the one exception — admit_host() GROWS it (with the
-    view arrays, under ``_lock``) when a late-started `--grow` process
-    leases itself into the rendezvous dir mid-run."""
+    published liveness view, the lease-receipt table, the stop flag) is
+    guarded by ``_lock`` (enforced by `sparknet lint` SPK201/202).
+    Configuration fields (dir/host/lease_s/...) are immutable after
+    __init__; the world size ``n`` is the one exception —
+    admit_host() GROWS it (with the view arrays, under ``_lock``) when
+    a late-started `--grow` process leases itself into the rendezvous
+    dir mid-run.
+
+    ``clock``/``dirops``: the time + storage seam (resilience/seam.py).
+    Leave at None for production (wall clock, real directory); the
+    fleet simulator injects SimClock/MemDir and this class runs
+    unchanged against virtual time."""
 
     def __init__(self, directory, host=None, n_hosts=None, interval_s=0.5,
-                 lease_s=3.0, metrics=None, log_fn=print, chaos=None):
+                 lease_s=3.0, metrics=None, log_fn=print, chaos=None,
+                 clock=None, dirops=None):
         if host is None or n_hosts is None:
             raise ValueError("heartbeat needs host= (this process's id) "
                              "and n_hosts= (the world size)")
         self.dir = str(directory)
-        os.makedirs(self.dir, exist_ok=True)
+        self.clock = WALL_CLOCK if clock is None else clock
+        # the default Dir seam creates the rendezvous dir on disk; an
+        # injected one (the simulator's MemDir) owns its own storage
+        self.dirops = _HeartbeatDir(self.dir) if dirops is None else dirops
         self.host = int(host)
         self.n = int(n_hosts)
         if not (0 <= self.host < self.n):
@@ -144,7 +192,11 @@ class HeartbeatCoordinator:
         self._age_view = np.zeros(self.n, np.float64)  # spk: guarded-by=_lock
         self._ever_dead = set()                      # spk: guarded-by=_lock
         self._stopped = False                        # spk: guarded-by=_lock
-        self._t0 = time.time()
+        # lease receipts: host -> ((seq, stamp), monotonic-at-receipt,
+        # initial age). Freshness is monotonic from the receipt, so a
+        # wall-clock step can never mass-expire peers (ISSUE 15).
+        self._lease_seen = {}                        # spk: guarded-by=_lock
+        self._t0_mono = self.clock.monotonic()
         self._stop = threading.Event()
         self._thread = None
         if self.chaos is not None and self.n > 1:
@@ -155,8 +207,11 @@ class HeartbeatCoordinator:
             self.chaos.kill_host_self_mode = True
 
     # -- the lease ---------------------------------------------------------
+    def _hb_name(self, host):
+        return f"hb-{int(host)}.json"
+
     def _hb_path(self, host):
-        return os.path.join(self.dir, f"hb-{int(host)}.json")
+        return os.path.join(self.dir, self._hb_name(host))
 
     def beat(self):                          # spk: thread-entry
         """Re-lease this host's liveness (writer thread + round
@@ -175,8 +230,8 @@ class HeartbeatCoordinator:
                 return
             self._seq += 1
             rec = {"host": self.host, "seq": self._seq,
-                   "round": self._round, "stamp": time.time()}
-        atomic_write_json(self._hb_path(self.host), rec)
+                   "round": self._round, "stamp": self.clock.time()}
+        self.dirops.write_json(self._hb_name(self.host), rec)
 
     def announce_round(self, round_idx):
         """Post this host's arrival at ``round_idx`` (the rendezvous
@@ -196,11 +251,14 @@ class HeartbeatCoordinator:
         and every orphaned round file with an mtime that old, and emit
         one ``ghost_reaped`` metrics event naming them. Fresh files from
         live peers of THIS run are untouched (they re-lease every
-        interval_s, so their stamps are never near the lease)."""
-        now = time.time()
+        interval_s, so their stamps are never near the lease). Stamp
+        comparisons here are wall-vs-wall across PROCESSES — the one
+        place that has to be, because the ghost's clock is all it left
+        behind."""
+        now = self.clock.time()
         ghost_hosts, orphans = [], 0
-        for p in glob.glob(os.path.join(glob.escape(self.dir), "hb-*.json")):
-            rec = _read_json(p)
+        for name in self.dirops.glob("hb-*.json"):
+            rec = self.dirops.read_json(name)
             stamp = float(rec.get("stamp", 0.0)) \
                 if rec is not None else 0.0
             if now - stamp <= self.lease_s:
@@ -210,29 +268,25 @@ class HeartbeatCoordinator:
             # re-leased this exact path between our glob read and now —
             # reaping its fresh lease would make the rejoin look like a
             # second crash. Fresh-on-second-read means live: skip it.
-            rec2 = _read_json(p)
+            rec2 = self.dirops.read_json(name)
             if rec2 is not None and \
-                    time.time() - float(rec2.get("stamp", 0.0)) \
+                    self.clock.time() - float(rec2.get("stamp", 0.0)) \
                     <= self.lease_s:
                 continue
             rec = rec2 or rec
-            try:
-                os.remove(p)
-            except OSError:
+            if not self.dirops.remove(name):
                 continue        # a concurrent peer reaped it first
             ghost_hosts.append(rec.get("host") if rec is not None
-                               else os.path.basename(p))
+                               else name)
         for pat in ("part-*.npz", "mask-*.json", "delta-*.npz",
                     "delta-*.json", "consensus-*.npz", "consensus-*.json",
                     "restart-*.json", "*.tmp.*"):
-            for p in glob.glob(os.path.join(glob.escape(self.dir), pat)):
-                try:
-                    if now - os.path.getmtime(p) <= self.lease_s:
-                        continue
-                    os.remove(p)
+            for name in self.dirops.glob(pat):
+                mt = self.dirops.mtime(name)
+                if mt is None or now - mt <= self.lease_s:
+                    continue
+                if self.dirops.remove(name):
                     orphans += 1
-                except OSError:
-                    pass
         if ghost_hosts or orphans:
             self.log(f"heartbeat: reaped {len(ghost_hosts)} ghost "
                      f"lease(s) {sorted(map(str, ghost_hosts))} and "
@@ -294,8 +348,8 @@ class HeartbeatCoordinator:
     def peers(self):
         """{host: lease record} for every heartbeat file present."""
         out = {}
-        for p in glob.glob(os.path.join(glob.escape(self.dir), "hb-*.json")):
-            rec = _read_json(p)
+        for name in self.dirops.glob("hb-*.json"):
+            rec = self.dirops.read_json(name)
             if rec is not None and isinstance(rec.get("host"), int):
                 out[rec["host"]] = rec
         return out
@@ -304,28 +358,57 @@ class HeartbeatCoordinator:
         """-> (alive bool (n,), lease_age_s (n,)). A host is alive while
         its lease is fresh; a host with NO heartbeat yet is granted one
         lease of startup grace (it may still be initializing), then
-        dead. This host is always alive to itself."""
-        now = time.time() if now is None else now
+        dead. This host is always alive to itself.
+
+        Freshness is MONOTONIC: a peer's age counts from the moment
+        this process last saw a NEW lease record for it (seq/stamp
+        advanced), not as ``wall_now - stamp`` — so an NTP step or a
+        suspend/resume can shift the wall clock arbitrarily without
+        expiring (or resurrecting) anyone. The on-disk wall stamp seeds
+        the age only the FIRST time a pre-existing record is seen: a
+        ghost's stale lease still reads as old on first sight. ``now``:
+        optional wall time for that first-sight seeding (tests)."""
+        mono = self.clock.monotonic()
+        wall = self.clock.time() if now is None else float(now)
         with self._lock:
             round_idx = self._round
+        n = self.n
         peers = self.peers()
-        alive = np.zeros(self.n, bool)
-        age = np.full(self.n, np.inf, np.float64)
-        for h in range(self.n):
+        recs = {}
+        for h in range(n):
             if h == self.host:
-                alive[h] = True
-                age[h] = 0.0
                 continue
-            rec = peers.get(h) if self._peer_visible(h, round_idx) else None
-            if rec is None:
-                # no heartbeat ever seen: one lease of startup grace
-                # (the peer may still be initializing), then dead
-                if now - self._t0 <= self.lease_s:
+            recs[h] = peers.get(h) \
+                if self._peer_visible(h, round_idx) else None
+        alive = np.zeros(n, bool)
+        age = np.full(n, np.inf, np.float64)
+        with self._lock:
+            for h in range(n):
+                if h == self.host:
                     alive[h] = True
                     age[h] = 0.0
-                continue
-            age[h] = max(0.0, now - float(rec.get("stamp", 0.0)))
-            alive[h] = age[h] <= self.lease_s
+                    continue
+                rec = recs.get(h)
+                if rec is None:
+                    # no heartbeat ever seen: one lease of startup
+                    # grace (the peer may still be initializing), then
+                    # dead
+                    if mono - self._t0_mono <= self.lease_s:
+                        alive[h] = True
+                        age[h] = 0.0
+                    continue
+                key = (rec.get("seq"), rec.get("stamp"))
+                seen = self._lease_seen.get(h)
+                if seen is None or seen[0] != key:
+                    # a new record: the receipt resets the age. First-
+                    # ever sight seeds from the wall stamp so a record
+                    # that predates this process (a ghost) reads old.
+                    init = max(0.0, wall - float(rec.get("stamp", 0.0))) \
+                        if seen is None else 0.0
+                    seen = (key, mono, init)
+                    self._lease_seen[h] = seen
+                age[h] = seen[2] + (mono - seen[1])
+                alive[h] = age[h] <= self.lease_s
         return alive, age
 
     def _refresh_view(self):                 # spk: thread-entry
@@ -333,12 +416,14 @@ class HeartbeatCoordinator:
         ``host_alive`` metrics event per liveness transition (the
         per-host liveness stream `sparknet monitor`/`report` render)."""
         alive, age = self.view()
+        n = len(alive)
         with self._lock:
             prev = self._alive_view
             self._alive_view = alive
             self._age_view = age
-            self._ever_dead |= {h for h in range(self.n) if not alive[h]}
-            flips = [h for h in range(self.n) if alive[h] != prev[h]]
+            self._ever_dead |= {h for h in range(n) if not alive[h]}
+            flips = [h for h in range(min(n, len(prev)))
+                     if alive[h] != prev[h]]
         for h in flips:
             self.log(f"heartbeat: host {h} is now "
                      f"{'ALIVE' if alive[h] else 'DEAD'} "
@@ -353,7 +438,7 @@ class HeartbeatCoordinator:
     def alive_hosts(self):
         """Host ids currently holding a fresh lease (this host's view)."""
         alive, _ = self.view()
-        return [h for h in range(self.n) if alive[h]]
+        return [h for h in range(len(alive)) if alive[h]]
 
     def live_processes(self):
         return self.alive_hosts()
@@ -376,8 +461,10 @@ class HeartbeatCoordinator:
         world size — late-started `--grow` processes leasing themselves
         into the rendezvous dir, waiting to be admitted at the next
         gate. Expired out-of-world leases (ghosts of a larger previous
-        run) are ignored; _reap_ghosts removed them at startup anyway."""
-        now = time.time()
+        run) are ignored; _reap_ghosts removed them at startup anyway.
+        Wall-vs-wall stamp comparison: the joiner is another process
+        this coordinator has no receipt history for."""
+        now = self.clock.time()
         return sorted(
             h for h, rec in self.peers().items()
             if h >= self.n and
@@ -408,7 +495,7 @@ class HeartbeatCoordinator:
         -1 — how a joiner fast-forwards its round counter to the front
         of the running world before its first gate (incumbents' gates
         accept any arrival at round >= theirs)."""
-        now = time.time()
+        now = self.clock.time()
         front = -1
         for h, rec in self.peers().items():
             if h == self.host or \
@@ -426,7 +513,8 @@ class HeartbeatCoordinator:
 
         expect: host ids to wait for (default: everyone else). Returns
         a GateResult; hosts in ``.dead`` should be evicted by the
-        caller's ElasticPolicy (reason "lease_expired")."""
+        caller's ElasticPolicy (reason "lease_expired"). The deadline
+        (and the reported wait) live on the monotonic clock."""
         if self.chaos is not None:
             # deterministic host-level injections anchored at the gate:
             # a killed host dies BEFORE announcing arrival (so peers see
@@ -446,12 +534,11 @@ class HeartbeatCoordinator:
         self.announce_round(round_idx)
         expect = set(range(self.n)) - {self.host} if expect is None \
             else {int(h) for h in expect} - {self.host}
-        deadline = None if timeout is None else time.time() + timeout
-        t0 = time.time()
+        t0 = self.clock.monotonic()
+        deadline = None if timeout is None else t0 + timeout
         arrived, dead = set(), set()
         while True:
-            now = time.time()
-            alive, age = self.view(now)
+            alive, age = self.view()
             peers = self.peers()
             for h in sorted(expect - arrived - dead):
                 rec = peers.get(h) \
@@ -459,16 +546,17 @@ class HeartbeatCoordinator:
                 if rec is not None and \
                         int(rec.get("round", -1)) >= round_idx:
                     arrived.add(h)
-                elif not alive[h]:
+                elif h < len(alive) and not alive[h]:
                     dead.add(h)
             if expect <= arrived | dead:
                 break
-            if deadline is not None and now >= deadline:
+            if deadline is not None and \
+                    self.clock.monotonic() >= deadline:
                 # an unresponsive-but-leasing host: report as neither
                 # arrived nor dead; the caller decides (straggler alarm)
                 break
-            time.sleep(min(self.interval_s / 4, 0.05))
-        res = GateResult(arrived, dead, time.time() - t0)
+            self.clock.sleep(min(self.interval_s / 4, 0.05))
+        res = GateResult(arrived, dead, self.clock.monotonic() - t0)
         if dead:
             with self._lock:
                 self._ever_dead |= dead
@@ -506,53 +594,57 @@ class FileConsensus:
          included, which makes readmission the same free re-broadcast
          as the replicated collective path
 
-    All file I/O is atomic-rename; round r's part files are deleted at
-    round r+2 so the directory stays O(hosts) files."""
+    All file I/O is atomic-rename through the coordinator's Dir seam;
+    round r's part files are deleted at round r+2 so the directory
+    stays O(hosts) files."""
 
     def __init__(self, coord, keep_rounds=2):
         self.coord = coord
         self.dir = coord.dir
+        self.dirops = coord.dirops
+        self.clock = coord.clock
         self.keep_rounds = max(1, int(keep_rounds))
 
-    def _part_path(self, host, round_idx):
-        return os.path.join(self.dir, f"part-{int(host)}-{int(round_idx)}.npz")
+    def _part_name(self, host, round_idx):
+        return f"part-{int(host)}-{int(round_idx)}.npz"
 
-    def _mask_path(self, round_idx):
-        return os.path.join(self.dir, f"mask-{int(round_idx)}.json")
+    def _mask_name(self, round_idx):
+        return f"mask-{int(round_idx)}.json"
 
     def _post(self, round_idx, leaves, valid, loss):
-        path = self._part_path(self.coord.host, round_idx)
         meta = json.dumps({"host": self.coord.host, "round": int(round_idx),
                            "valid": int(bool(valid)),
                            "loss": float(loss)})
-        atomic_write_bytes(
-            path,
-            lambda f: np.savez(
-                f, meta=np.frombuffer(meta.encode(), np.uint8),
-                **{f"leaf{i}": np.asarray(a)
-                   for i, a in enumerate(leaves)}))
+        arrays = {"meta": np.frombuffer(meta.encode(), np.uint8)}
+        for i, a in enumerate(leaves):
+            arrays[f"leaf{i}"] = np.asarray(a)
+        self.dirops.write_npz(self._part_name(self.coord.host, round_idx),
+                              arrays)
 
     def _load(self, host, round_idx, n_leaves):
+        z = self.dirops.load_npz(self._part_name(host, round_idx))
+        if z is None:
+            return None, None
         try:
-            with np.load(self._part_path(host, round_idx)) as z:
-                meta = json.loads(bytes(z["meta"]).decode())
-                leaves = [z[f"leaf{i}"] for i in range(n_leaves)]
-        except (OSError, ValueError, KeyError):
+            meta = json.loads(bytes(z["meta"]).decode())
+            leaves = [z[f"leaf{i}"] for i in range(n_leaves)]
+        except (KeyError, ValueError):
             return None, None
         return leaves, meta
 
     def _wait_parts(self, round_idx, hosts, deadline):
         """Hosts whose contribution for ``round_idx`` landed before
-        ``deadline`` (polling; arrival is the atomic rename)."""
+        monotonic ``deadline`` (polling; arrival is the atomic
+        rename)."""
         got = set()
         hosts = set(hosts)
         while True:
             for h in hosts - got:
-                if os.path.exists(self._part_path(h, round_idx)):
+                if self.dirops.exists(self._part_name(h, round_idx)):
                     got.add(h)
-            if got >= hosts or time.time() >= deadline:
+            if got >= hosts or self.clock.monotonic() >= deadline:
                 return got
-            time.sleep(min(self.coord.interval_s / 4, 0.05))
+            self.clock.sleep(min(self.coord.interval_s / 4, 0.05))
 
     def _decide_mask(self, round_idx, alive, deadline):
         """The round's membership: written once by the lowest live
@@ -562,7 +654,7 @@ class FileConsensus:
         way, so every host computes the identical consensus."""
         me = self.coord.host
         while True:
-            rec = _read_json(self._mask_path(round_idx))
+            rec = self.dirops.read_json(self._mask_name(round_idx))
             if rec is not None and rec.get("round") == round_idx:
                 return [int(h) for h in rec.get("included", [])]
             live = set(self.coord.alive_hosts())
@@ -570,23 +662,20 @@ class FileConsensus:
                 got = self._wait_parts(round_idx, set(alive) | {me},
                                        deadline)
                 mask = sorted(got)
-                atomic_write_json(self._mask_path(round_idx),
-                                   {"round": int(round_idx),
-                                    "included": mask, "authority": me})
+                self.dirops.write_json(self._mask_name(round_idx),
+                                       {"round": int(round_idx),
+                                        "included": mask, "authority": me})
                 return mask
-            time.sleep(min(self.coord.interval_s / 4, 0.05))
+            self.clock.sleep(min(self.coord.interval_s / 4, 0.05))
 
     def _gc(self, round_idx):
-        for p in glob.glob(os.path.join(glob.escape(self.dir), "part-*.npz")):
+        for name in self.dirops.glob("part-*.npz"):
             try:
-                r = int(p.rsplit("-", 1)[1].split(".")[0])
+                r = int(name.rsplit("-", 1)[1].split(".")[0])
             except ValueError:
                 continue
             if r <= round_idx - self.keep_rounds:
-                try:
-                    os.remove(p)
-                except OSError:
-                    pass
+                self.dirops.remove(name)
 
     def exchange(self, round_idx, leaves, valid, loss, alive_hosts,
                  timeout=None):
@@ -601,7 +690,7 @@ class FileConsensus:
         n = self.coord.n
         timeout = self.coord.lease_s if timeout is None else timeout
         self._post(round_idx, leaves, valid, loss)
-        deadline = time.time() + timeout
+        deadline = self.clock.monotonic() + timeout
         included = self._decide_mask(round_idx, set(alive_hosts), deadline)
         parts, metas = {}, {}
         for h in included:
@@ -691,35 +780,34 @@ class AsyncFileConsensus(FileConsensus):
 
     # -- files ---------------------------------------------------------------
     def _delta_npz(self, host, v):
-        return os.path.join(self.dir, f"delta-{int(host)}-{int(v)}.npz")
+        return f"delta-{int(host)}-{int(v)}.npz"
 
     def _delta_meta(self, host, v):
-        return os.path.join(self.dir, f"delta-{int(host)}-{int(v)}.json")
+        return f"delta-{int(host)}-{int(v)}.json"
 
     def _consensus_npz(self, v):
-        return os.path.join(self.dir, f"consensus-{int(v)}.npz")
+        return f"consensus-{int(v)}.npz"
 
     def _consensus_meta(self, v):
-        return os.path.join(self.dir, f"consensus-{int(v)}.json")
+        return f"consensus-{int(v)}.json"
 
     def _push(self, v, leaves, valid, loss):
         """Payload first, meta last — the meta's atomic rename commits
         the delta, so a reader that sees the meta can read the npz."""
-        path = self._delta_npz(self.coord.host, v)
-        atomic_write_bytes(
-            path, lambda f: np.savez(f, **{f"leaf{i}": np.asarray(a)
-                                           for i, a in enumerate(leaves)}))
-        atomic_write_json(self._delta_meta(self.coord.host, v),
-                           {"host": self.coord.host, "version": int(v),
-                            "valid": int(bool(valid)),
-                            "loss": float(loss), "stamp": time.time()})
+        self.dirops.write_npz(self._delta_npz(self.coord.host, v),
+                              {f"leaf{i}": np.asarray(a)
+                               for i, a in enumerate(leaves)})
+        self.dirops.write_json(self._delta_meta(self.coord.host, v),
+                               {"host": self.coord.host, "version": int(v),
+                                "valid": int(bool(valid)),
+                                "loss": float(loss),
+                                "stamp": self.clock.time()})
 
     def _peer_versions(self):
         """{host: newest committed delta version} from the meta files."""
         vers = {}
-        for p in glob.glob(os.path.join(glob.escape(self.dir),
-                                        "delta-*.json")):
-            rec = _read_json(p)
+        for name in self.dirops.glob("delta-*.json"):
+            rec = self.dirops.read_json(name)
             if rec is None or not isinstance(rec.get("host"), int):
                 continue
             h, v = rec["host"], int(rec.get("version", -1))
@@ -728,13 +816,15 @@ class AsyncFileConsensus(FileConsensus):
         return vers
 
     def _load_delta(self, host, v, n_leaves):
-        meta = _read_json(self._delta_meta(host, v))
+        meta = self.dirops.read_json(self._delta_meta(host, v))
         if meta is None:
             return None, None
+        z = self.dirops.load_npz(self._delta_npz(host, v))
+        if z is None:
+            return None, None
         try:
-            with np.load(self._delta_npz(host, v)) as z:
-                leaves = [z[f"leaf{i}"] for i in range(n_leaves)]
-        except (OSError, ValueError, KeyError):
+            leaves = [z[f"leaf{i}"] for i in range(n_leaves)]
+        except KeyError:
             return None, None
         return leaves, meta
 
@@ -745,9 +835,9 @@ class AsyncFileConsensus(FileConsensus):
         the lowest live host; failover is automatic (the next-lowest
         live host sees itself lowest once the lease expires). Idempotent
         per v_ref — an existing consensus file is left alone."""
-        if _read_json(self._consensus_meta(v_ref)) is not None:
+        if self.dirops.read_json(self._consensus_meta(v_ref)) is not None:
             return
-        included, acc, wsum = [], None, 0.0
+        included, wsum = [], 0.0
         parts = {}
         for h in sorted(live):
             vh = vers.get(h, -1)
@@ -778,32 +868,32 @@ class AsyncFileConsensus(FileConsensus):
                              "loss": float(meta.get("loss",
                                                     float("nan"))),
                              "div_sq": div})
-        atomic_write_bytes(
-            self._consensus_npz(v_ref),
-            lambda f: np.savez(f, **{f"leaf{i}": c.astype(np.float64)
-                                     for i, c in enumerate(consensus)}))
-        atomic_write_json(self._consensus_meta(v_ref),
-                           {"version": int(v_ref),
-                            "authority": self.coord.host,
-                            "included": included,
-                            "stamp": time.time()})
+        self.dirops.write_npz(self._consensus_npz(v_ref),
+                              {f"leaf{i}": c.astype(np.float64)
+                               for i, c in enumerate(consensus)})
+        self.dirops.write_json(self._consensus_meta(v_ref),
+                               {"version": int(v_ref),
+                                "authority": self.coord.host,
+                                "included": included,
+                                "stamp": self.clock.time()})
 
     def _latest_consensus(self, n_leaves):
         """(version, leaves, meta) of the newest committed consensus,
         or (None,)*3 — purely a read, never a wait."""
         best = None
-        for p in glob.glob(os.path.join(glob.escape(self.dir),
-                                        "consensus-*.json")):
-            rec = _read_json(p)
+        for name in self.dirops.glob("consensus-*.json"):
+            rec = self.dirops.read_json(name)
             if rec is not None and isinstance(rec.get("version"), int):
                 if best is None or rec["version"] > best["version"]:
                     best = rec
         if best is None:
             return None, None, None
+        z = self.dirops.load_npz(self._consensus_npz(best["version"]))
+        if z is None:
+            return None, None, None
         try:
-            with np.load(self._consensus_npz(best["version"])) as z:
-                leaves = [z[f"leaf{i}"] for i in range(n_leaves)]
-        except (OSError, ValueError, KeyError):
+            leaves = [z[f"leaf{i}"] for i in range(n_leaves)]
+        except KeyError:
             return None, None, None
         return best["version"], leaves, best
 
@@ -812,30 +902,22 @@ class AsyncFileConsensus(FileConsensus):
         removed (its stale pushes must stop haunting merges), and
         committed versions older than the keep window are trimmed."""
         floor = max(vers.values(), default=0) - self.s - self.keep_versions
-        for p in glob.glob(os.path.join(glob.escape(self.dir),
-                                        "delta-*.json")):
-            rec = _read_json(p)
+        for name in self.dirops.glob("delta-*.json"):
+            rec = self.dirops.read_json(name)
             if rec is None:
                 continue
             h, v = rec.get("host"), int(rec.get("version", -1))
             dead = isinstance(h, int) and h not in live
             if dead or v < floor:
-                for path in (p, self._delta_npz(h, v)):
-                    try:
-                        os.remove(path)
-                    except OSError:
-                        pass
+                self.dirops.remove(name)
+                self.dirops.remove(self._delta_npz(h, v))
         keep = self.keep_versions
-        cons = sorted(int(p.rsplit("-", 1)[1].split(".")[0])
-                      for p in glob.glob(os.path.join(
-                          glob.escape(self.dir), "consensus-*.json"))
-                      if p.rsplit("-", 1)[1].split(".")[0].isdigit())
+        cons = sorted(int(name.rsplit("-", 1)[1].split(".")[0])
+                      for name in self.dirops.glob("consensus-*.json")
+                      if name.rsplit("-", 1)[1].split(".")[0].isdigit())
         for v in cons[:-keep] if len(cons) > keep else []:
-            for path in (self._consensus_npz(v), self._consensus_meta(v)):
-                try:
-                    os.remove(path)
-                except OSError:
-                    pass
+            self.dirops.remove(self._consensus_npz(v))
+            self.dirops.remove(self._consensus_meta(v))
 
     # -- the exchange --------------------------------------------------------
     def exchange(self, round_idx, leaves, valid, loss, alive_hosts,
@@ -931,18 +1013,19 @@ def restart_barrier(coord, sha, timeout=30.0):
     peer to post theirs. Returns (agreed, shas_by_host). Used on quorum
     loss so all survivors exit 4 holding the SAME resumable manifest —
     the supervisor relaunch then resumes one consistent world."""
-    path = os.path.join(coord.dir, f"restart-{coord.host}.json")
-    atomic_write_json(path, {"host": coord.host, "sha": sha,
-                             "stamp": time.time()})
-    deadline = time.time() + timeout
+    coord.dirops.write_json(f"restart-{coord.host}.json",
+                            {"host": coord.host, "sha": sha,
+                             "stamp": coord.clock.time()})
+    deadline = coord.clock.monotonic() + timeout
     while True:
         live = coord.alive_hosts()
         shas = {}
         for h in live:
-            rec = _read_json(os.path.join(coord.dir, f"restart-{h}.json"))
+            rec = coord.dirops.read_json(f"restart-{h}.json")
             if rec is not None:
                 shas[h] = rec.get("sha")
-        if set(live) <= set(shas) or time.time() >= deadline:
+        if set(live) <= set(shas) or \
+                coord.clock.monotonic() >= deadline:
             agreed = len(set(shas.values())) == 1 and \
                 set(live) <= set(shas)
             if coord.metrics is not None:
@@ -958,4 +1041,4 @@ def restart_barrier(coord, sha, timeout=30.0):
                           f"{str(sha)[:12]}… — exiting for supervisor "
                           "relaunch")
             return agreed, shas
-        time.sleep(min(coord.interval_s / 2, 0.1))
+        coord.clock.sleep(min(coord.interval_s / 2, 0.1))

@@ -1,0 +1,497 @@
+"""Fleet-scale chaos simulation (sparknet_tpu/sim/): the SimClock /
+MemDir halves of the Clock/Dir seam, monotonic lease freshness under
+wall-clock jumps, the fail_rate/fail_corr chaos grammar, table-driven
+lease boundary semantics against the REAL HeartbeatCoordinator and
+ElasticPolicy, FleetSim end-to-end (scheduled deaths, repair, quorum
+loss, consensus transports), replay validation against a real
+multi-coordinator run, the sweep grid driver, and report/monitor
+rendering of a simulated metrics stream."""
+
+import time
+
+import numpy as np
+import pytest
+
+from conftest import REFERENCE  # noqa: F401  (conftest sets the cpu env)
+
+from sparknet_tpu.resilience.chaos import ChaosMonkey
+from sparknet_tpu.resilience.elastic import ElasticPolicy
+from sparknet_tpu.resilience.heartbeat import HeartbeatCoordinator
+from sparknet_tpu.sim import FleetSim, MemDir, SimClock
+from sparknet_tpu.sim.replay import (SequenceSink, record_real,
+                                     replay_sim)
+from sparknet_tpu.sim.sweep import (parse_grid, render_table, run_cell,
+                                    run_sweep)
+
+
+class _Sink:
+    def __init__(self):
+        self.events = []
+
+    def log(self, event, **fields):
+        self.events.append(dict(fields, event=event))
+
+    def kinds(self):
+        return [e["event"] for e in self.events]
+
+    def of(self, kind):
+        return [e for e in self.events if e["event"] == kind]
+
+
+def _quiet(*a, **k):
+    pass
+
+
+def _sim_coord(clock, dirops, host, n, interval=0.2, lease=1.0, **kw):
+    return HeartbeatCoordinator(dirops.root, host=host, n_hosts=n,
+                                interval_s=interval, lease_s=lease,
+                                log_fn=_quiet, clock=clock,
+                                dirops=dirops, **kw)
+
+
+# ------------------------------------------------------------ SimClock ----
+class TestSimClock:
+    def test_sleep_advances_monotonic_and_wall_together(self):
+        c = SimClock()
+        m0, w0 = c.monotonic(), c.time()
+        c.sleep(2.5)
+        assert c.monotonic() == pytest.approx(m0 + 2.5)
+        assert c.time() == pytest.approx(w0 + 2.5)
+
+    def test_events_fire_in_due_order_with_fifo_ties(self):
+        c = SimClock()
+        seen = []
+        c.after(2.0, lambda: seen.append("b"))
+        c.after(1.0, lambda: seen.append("a"))
+        c.after(2.0, lambda: seen.append("c"))   # same due as "b"
+        c.sleep(3.0)
+        assert seen == ["a", "b", "c"]
+
+    def test_events_scheduled_while_firing_still_fire(self):
+        c = SimClock()
+        seen = []
+
+        def recurse():
+            seen.append(c.monotonic())
+            if len(seen) < 3:
+                c.after(1.0, recurse)
+        c.after(1.0, recurse)
+        c.sleep(10.0)
+        assert seen == pytest.approx([1.0, 2.0, 3.0])
+        assert c.monotonic() == pytest.approx(10.0)
+
+    def test_past_due_clamps_to_now(self):
+        c = SimClock()
+        c.sleep(5.0)
+        seen = []
+        c.at(1.0, lambda: seen.append(True))     # already in the past
+        c.sleep(0.0)
+        assert seen == [True]
+
+    def test_jump_wall_moves_wall_but_never_monotonic(self):
+        c = SimClock()
+        c.sleep(1.0)
+        m, w = c.monotonic(), c.time()
+        c.jump_wall(-3600.0)
+        assert c.monotonic() == m                # monotonic is immune
+        assert c.time() == pytest.approx(w - 3600.0)
+        c.jump_wall(+7200.0)
+        assert c.time() == pytest.approx(w + 3600.0)
+
+    def test_pending_counts_unfired_events(self):
+        c = SimClock()
+        c.after(1.0, lambda: None)
+        c.after(2.0, lambda: None)
+        assert c.pending() == 2
+        c.sleep(1.5)
+        assert c.pending() == 1
+
+
+# -------------------------------------------------------------- MemDir ----
+class TestMemDir:
+    def test_json_roundtrip_and_mtime(self):
+        clock = SimClock()
+        d = MemDir(clock)
+        d.write_json("hb-0.json", {"host": 0, "seq": 1})
+        assert d.read_json("hb-0.json") == {"host": 0, "seq": 1}
+        assert d.mtime("hb-0.json") == clock.time()
+        clock.sleep(2.0)
+        d.write_json("hb-0.json", {"host": 0, "seq": 2})
+        assert d.mtime("hb-0.json") == clock.time()
+
+    def test_glob_is_sorted_and_pattern_scoped(self):
+        d = MemDir(SimClock())
+        for name in ("hb-2.json", "hb-0.json", "round-0.part", "hb-1.json"):
+            d.write_json(name, {})
+        assert d.glob("hb-*.json") == ["hb-0.json", "hb-1.json",
+                                       "hb-2.json"]
+        assert d.glob("*.part") == ["round-0.part"]
+
+    def test_npz_roundtrip_returns_copy(self):
+        d = MemDir(SimClock())
+        d.write_npz("x.npz", {"a": np.arange(4)})
+        out = d.load_npz("x.npz")
+        assert list(out["a"]) == [0, 1, 2, 3]
+        out["b"] = 1                              # caller's copy only
+        assert "b" not in d.load_npz("x.npz")
+
+    def test_missing_and_remove(self):
+        d = MemDir(SimClock())
+        assert d.read_json("nope.json") is None
+        assert d.load_npz("nope.npz") is None
+        assert d.mtime("nope.json") is None
+        assert not d.exists("nope.json")
+        d.write_json("x.json", {})
+        assert d.remove("x.json") and not d.exists("x.json")
+        assert not d.remove("x.json")
+
+
+# ------------------------------------- wall jumps never evict (bugfix) ----
+class TestWallClockJumps:
+    """Satellite regression: lease freshness and gate deadlines live on
+    the monotonic clock, so NTP steps / suspend-resume wall jumps in
+    EITHER direction must not expire (or resurrect) anyone."""
+
+    @pytest.mark.parametrize("jump_s", [-3600.0, +3600.0],
+                             ids=["backwards", "forwards"])
+    def test_wall_jump_mid_run_evicts_nobody(self, jump_s):
+        clock = SimClock()
+        d = MemDir(clock)
+        a = _sim_coord(clock, d, 0, 2)
+        b = _sim_coord(clock, d, 1, 2)
+        b.beat()
+        a.view()                      # register the lease receipt
+        clock.jump_wall(jump_s)
+        clock.sleep(0.1)              # well inside the 1.0s lease
+        alive, age = a.view()
+        assert alive[1], f"wall jump {jump_s:+g}s expired a live lease"
+        assert age[1] == pytest.approx(0.1)
+        # the gate deadline is monotonic too: a bounded gate neither
+        # hangs nor reports the leasing-but-unarrived peer dead
+        res = a.gate(0, expect={1}, timeout=0.3)
+        assert not res.dead
+        assert res.wait_s == pytest.approx(0.3, abs=0.06)
+
+    def test_ghost_lease_reads_old_on_first_sight(self):
+        # first-ever sight seeds the age from the wall stamp: a record
+        # that predates this process must NOT be granted a fresh lease
+        clock = SimClock()
+        d = MemDir(clock)
+        b = _sim_coord(clock, d, 1, 2)
+        b.beat()
+        clock.sleep(10.0)             # 10x the lease, no re-lease
+        a = _sim_coord(clock, d, 0, 2)
+        alive, age = a.view()
+        assert not alive[1]
+        assert age[1] == pytest.approx(10.0)
+
+
+# ----------------------------------------------- chaos failure grammar ----
+class TestFailRateGrammar:
+    def test_parse_round_trips_the_new_tokens(self):
+        c = ChaosMonkey.parse("fail_rate=0.01,fail_seed=9,fail_corr=4",
+                              log_fn=_quiet)
+        assert (c.fail_rate, c.fail_seed, c.fail_corr) == (0.01, 9, 4)
+
+    @pytest.mark.parametrize("spec", ["fail_rate=nope", "fail_seed=1.5x",
+                                      "fail_rate=2.0", "fail_rat=0.1"])
+    def test_bad_tokens_error_naming_the_token(self, spec):
+        with pytest.raises(ValueError) as err:
+            ChaosMonkey.parse(spec, log_fn=_quiet)
+        assert spec.split(",")[0].split("=")[0].rstrip("e") \
+            .rstrip("t")[:8] in str(err.value) or spec in str(err.value)
+
+    def test_victim_timeline_is_deterministic_per_seed(self):
+        a = ChaosMonkey.parse("fail_rate=0.2,fail_seed=7", log_fn=_quiet)
+        b = ChaosMonkey.parse("fail_rate=0.2,fail_seed=7", log_fn=_quiet)
+        seq_a = [a.fail_rate_victims(r, 64) for r in range(10)]
+        seq_b = [b.fail_rate_victims(r, 64) for r in range(10)]
+        assert seq_a == seq_b
+        assert any(seq_a), "p=0.2 over 10 rounds x 64 hosts drew nothing"
+        c = ChaosMonkey.parse("fail_rate=0.2,fail_seed=8", log_fn=_quiet)
+        assert seq_a != [c.fail_rate_victims(r, 64) for r in range(10)]
+
+    def test_victims_are_newly_dead_only_until_revived(self):
+        # the process reports deltas: an already-down host cannot die
+        # twice, and only a revive re-arms it
+        c = ChaosMonkey.parse("fail_rate=1.0", log_fn=_quiet)
+        assert c.fail_rate_victims(0, 4) == [0, 1, 2, 3]
+        assert c.fail_rate_victims(1, 4) == []
+        c.revive_host(2)
+        assert c.fail_rate_victims(2, 4) == [2]
+
+    def test_fail_rate_one_kills_everyone(self):
+        c = ChaosMonkey.parse("fail_rate=1.0", log_fn=_quiet)
+        assert c.fail_rate_victims(0, 5) == [0, 1, 2, 3, 4]
+
+    def test_fail_corr_kills_whole_domains(self):
+        c = ChaosMonkey.parse("fail_rate=0.5,fail_seed=3,fail_corr=4",
+                              log_fn=_quiet)
+        hit = False
+        for r in range(20):
+            victims = set(c.fail_rate_victims(r, 16))
+            hit = hit or bool(victims)
+            for v in victims:
+                dom = v // 4
+                assert set(range(dom * 4, dom * 4 + 4)) <= victims, \
+                    f"round {r}: domain {dom} died partially: {victims}"
+        assert hit, "p=0.5 over 20 rounds x 4 domains drew no failures"
+
+    def test_dead_hosts_carries_victims_and_emits_the_event(self):
+        sink = _Sink()
+        c = ChaosMonkey.parse("fail_rate=1.0", metrics=sink,
+                              log_fn=_quiet)
+        assert set(c.dead_hosts(0, 3)) == {0, 1, 2}
+        assert any(e.get("kind") == "fail_rate" for e in sink.of("chaos"))
+        c.revive_host(1)
+        assert set(c.dead_hosts(1, 3)) == {1}    # p=1 re-kills it
+
+
+# ------------------------------------------------- lease boundaries -------
+#: (advance after the lease receipt, alive expected) — the lease is
+#: inclusive at exactly lease_s (age <= lease_s), dead just beyond
+LEASE_EDGE = [(0.5, True), (0.999, True), (1.0, True), (1.001, False),
+              (3.0, False)]
+
+
+class TestLeaseBoundaries:
+    @pytest.mark.parametrize("advance,alive_expected", LEASE_EDGE)
+    def test_beat_exactly_at_lease_expiry(self, advance, alive_expected):
+        clock = SimClock()
+        d = MemDir(clock)
+        a = _sim_coord(clock, d, 0, 2, lease=1.0)
+        b = _sim_coord(clock, d, 1, 2, lease=1.0)
+        b.beat()
+        a.view()                      # receipt at age 0
+        clock.sleep(advance)
+        alive, age = a.view()
+        assert bool(alive[1]) is alive_expected
+        assert age[1] == pytest.approx(advance)
+
+    @pytest.mark.parametrize("arrive_at,arrives", [
+        (0.1, True),                  # early
+        (0.48, True),                 # the final poll before deadline
+        (0.60, False),                # after the deadline: straggler
+    ])
+    def test_gate_peer_arriving_on_final_poll(self, arrive_at, arrives):
+        clock = SimClock()
+        d = MemDir(clock)
+        a = _sim_coord(clock, d, 0, 2, interval=0.2, lease=5.0)
+        b = _sim_coord(clock, d, 1, 2, interval=0.2, lease=5.0)
+        b.beat()
+        a.view()
+        clock.after(arrive_at, lambda: b.announce_round(3))
+        res = a.gate(3, expect={1}, timeout=0.5)
+        assert (1 in res.arrived) is arrives
+        # a leasing-but-late peer is NEITHER arrived nor dead — the
+        # caller's straggler alarm decides, not an eviction
+        assert not res.dead
+
+    @pytest.mark.parametrize("readmit_after", [1, 2, 4])
+    def test_readmit_cooldown_with_evict_after_one(self, readmit_after):
+        sink = _Sink()
+        pol = ElasticPolicy(n_workers=4, quorum=1, evict_after=1,
+                            readmit_after=readmit_after, metrics=sink,
+                            log_fn=_quiet, unit="host")
+        pol.evict(2, 3, "lease_expired")
+        for r in range(3, 3 + readmit_after + 1):
+            pol.observe_round(r)
+        back = [e["round"] for e in sink.of("readmission")
+                if e.get("worker") == 2]
+        assert back == [3 + readmit_after]
+
+
+# ------------------------------------------------------------ FleetSim ----
+class TestFleetSim:
+    def test_same_seed_same_timeline(self):
+        kw = dict(hosts=6, rounds=8, interval_s=0.25, lease_s=1.0,
+                  round_s=0.3, quorum=1, consensus="none",
+                  chaos="fail_rate=0.05,fail_seed=11", recover_after=2,
+                  seed=4)
+        assert FleetSim(**kw).run() == FleetSim(**kw).run()
+
+    def test_scheduled_death_evicts_via_lease_expiry(self):
+        sink = _Sink()
+        s = FleetSim(hosts=4, rounds=8, interval_s=0.25, lease_s=1.0,
+                     round_s=0.3, consensus="none", deaths={2: 3},
+                     metrics=sink)
+        out = s.run()
+        ev = [(e["host"], e["round"]) for e in sink.of("host_evicted")]
+        assert ev and ev[0][0] == 2
+        assert all(e["reason"] == "lease_expired"
+                   for e in sink.of("host_evicted"))
+        assert out["live_final"] == 3 and not out["quorum_lost"]
+
+    def test_recover_after_readmits_the_dead(self):
+        s = FleetSim(hosts=4, rounds=12, interval_s=0.25, lease_s=1.0,
+                     round_s=0.3, consensus="none", deaths={2: 3},
+                     recover_after=3)
+        out = s.run()
+        assert out["admissions"] >= 1
+        assert out["live_final"] == 4
+
+    def test_churn_signature_evict_readmit_reevict(self):
+        # the cooldown-readmission churn loop: a host that stays dead
+        # is readmitted by the cooldown and re-evicted by its still-
+        # lapsed lease — the hard sequencing case
+        sink = SequenceSink()
+        FleetSim(hosts=4, rounds=12, interval_s=0.25, lease_s=1.0,
+                 round_s=0.3, consensus="none", deaths={2: 4},
+                 readmit_after=3, jitter=0.0, metrics=sink).run()
+        kinds = [e[0] for e in sink.sequence if e[1] == 2]
+        assert kinds[:3] == ["host_evicted", "readmission",
+                             "host_evicted"]
+
+    def test_quorum_loss_halts_the_fleet(self):
+        s = FleetSim(hosts=3, rounds=10, interval_s=0.25, lease_s=1.0,
+                     round_s=0.3, quorum=3, consensus="none",
+                     deaths={1: 2})
+        out = s.run()
+        assert out["quorum_lost"]
+        assert out["rounds"] < 10
+
+    def test_sync_consensus_converges_surrogate_leaves(self):
+        s = FleetSim(hosts=4, rounds=5, interval_s=0.25, lease_s=1.5,
+                     round_s=0.3, consensus="sync", jitter=0.0)
+        out = s.run()
+        assert out["consensus"] == "sync" and not out["quorum_lost"]
+        for leaf in s.leaves[1:]:
+            np.testing.assert_allclose(leaf, s.leaves[0])
+
+    def test_async_consensus_with_staleness_runs(self):
+        out = FleetSim(hosts=4, rounds=8, interval_s=0.25, lease_s=1.5,
+                       round_s=0.3, consensus="async",
+                       staleness=2).run()
+        assert out["consensus"] == "async"
+        assert out["staleness"] == 2 and not out["quorum_lost"]
+
+    def test_auto_consensus_drops_transport_at_scale(self):
+        assert FleetSim(hosts=4).consensus == "sync"
+        assert FleetSim(hosts=4, staleness=2).consensus == "async"
+        assert FleetSim(hosts=64).consensus == "none"
+
+    def test_sim_event_matches_the_closed_schema(self):
+        from sparknet_tpu.obs.event_schema import EVENTS
+        sink = _Sink()
+        FleetSim(hosts=4, rounds=4, interval_s=0.25, lease_s=1.0,
+                 round_s=0.3, consensus="none", metrics=sink).run()
+        evs = sink.of("sim")
+        assert len(evs) == 4
+        spec = EVENTS["sim"]
+        assert not spec["open"]
+        for e in evs:
+            assert sorted(k for k in e if k != "event") == \
+                sorted(spec["fields"])
+
+    def test_midsize_fleet_stays_cheap_on_cpu(self):
+        # the scaled-down cousin of the 1000x200 acceptance cell (kept
+        # tier-1-fast); the full cell runs under @slow and in smoke
+        t0 = time.time()
+        out = FleetSim(hosts=300, rounds=40, interval_s=0.2,
+                       lease_s=0.6, round_s=0.15, quorum=200,
+                       consensus="none", recover_after=5,
+                       chaos="fail_rate=0.0005,fail_seed=7").run()
+        assert time.time() - t0 < 20.0
+        assert not out["quorum_lost"]
+        assert out["rounds"] == 40
+
+    @pytest.mark.slow
+    def test_thousand_host_cell_under_budget(self):
+        t0 = time.time()
+        out = FleetSim(hosts=1000, rounds=200, interval_s=0.2,
+                       lease_s=0.6, round_s=0.15, quorum=800,
+                       consensus="none", recover_after=5,
+                       chaos="fail_rate=0.0002,fail_seed=7").run()
+        assert time.time() - t0 < 60.0
+        assert out["rounds"] == 200 and not out["quorum_lost"]
+
+
+# ------------------------------------------------------ replay gate -------
+class TestReplayValidation:
+    def test_sim_reproduces_a_real_run_exactly(self, tmp_path):
+        rec = record_real(str(tmp_path), hosts=3, rounds=7,
+                          kill_round=2, interval_s=0.1, lease_s=0.5,
+                          round_s=0.12, readmit_after=3)
+        assert rec["sequence"], "the real run recorded no membership"
+        match, real_seq, sim_seq = replay_sim(rec)
+        assert match, f"replay diverged:\n real {real_seq}\n sim {sim_seq}"
+
+
+# ------------------------------------------------------------- sweeps -----
+class TestSweep:
+    def test_grid_is_the_cartesian_product_in_spec_order(self):
+        cells = parse_grid("hosts=2:4,lease_s=1.0:2.0,quorum=1")
+        assert cells == [
+            {"hosts": 2, "lease_s": 1.0, "quorum": 1},
+            {"hosts": 2, "lease_s": 2.0, "quorum": 1},
+            {"hosts": 4, "lease_s": 1.0, "quorum": 1},
+            {"hosts": 4, "lease_s": 2.0, "quorum": 1},
+        ]
+
+    @pytest.mark.parametrize("spec,needle", [
+        ("hosst=2", "hosst"),                 # unknown axis
+        ("hosts=two", "two"),                 # unconvertible value
+        ("hosts", "hosts"),                   # no '='
+    ])
+    def test_bad_specs_error_naming_the_token(self, spec, needle):
+        with pytest.raises(ValueError) as err:
+            parse_grid(spec)
+        assert needle in str(err.value)
+        assert "valid axes" in str(err.value)
+
+    def test_run_cell_routes_chaos_axes_and_echoes_the_cell(self):
+        cell = {"hosts": 4, "rounds": 3, "interval_s": 0.25,
+                "lease_s": 1.0, "round_s": 0.3, "fail_rate": 0.0,
+                "fail_seed": 1}
+        out = run_cell(cell)
+        assert out["cell"] == cell
+        assert out["hosts"] == 4 and out["rounds"] == 3
+        assert "real_s" in out
+
+    def test_budget_stops_early_and_says_so(self):
+        lines = []
+        cells = parse_grid("hosts=2,rounds=2,round_s=0.2,"
+                           "lease_s=1.0") * 3
+        out = run_sweep(cells, log_fn=lambda m: lines.append(m),
+                        budget_s=0.0)
+        assert out == []
+        assert any("NOT run" in l for l in lines)
+
+    def test_render_table_has_the_tuning_columns(self):
+        cells = parse_grid("hosts=2,rounds=2,round_s=0.2,lease_s=1.0,"
+                           "fail_rate=0.0")
+        txt = render_table(run_sweep(cells))
+        for col in ("hosts", "lease", "wait_p95", "wait_max", "qlost",
+                    "chaos/tau/s"):
+            assert col in txt.splitlines()[0]
+        assert len(txt.splitlines()) == 2
+
+
+# ---------------------------------------------- report / monitor ----------
+class TestSimObservability:
+    def _events(self):
+        sink = _Sink()
+        FleetSim(hosts=4, rounds=6, interval_s=0.25, lease_s=1.0,
+                 round_s=0.3, consensus="none", deaths={2: 2},
+                 recover_after=2, metrics=sink).run()
+        return sink.events
+
+    def test_report_aggregates_and_renders_the_sim_section(self):
+        from sparknet_tpu.obs import report as obs_report
+        rep = obs_report.aggregate(self._events())
+        sim = rep["simulation"]
+        assert sim["hosts"] == 4 and sim["rounds"] == 6
+        assert sim["evictions"] >= 1 and sim["admissions"] >= 1
+        txt = obs_report.render(rep)
+        assert "fleet simulation" in txt
+        assert "4 virtual hosts x 6 rounds" in txt
+
+    def test_monitor_renders_the_live_sim_line(self):
+        from sparknet_tpu.obs.monitor import MonitorState
+        st = MonitorState()
+        for e in self._events():
+            ev = dict(e)
+            st.update(dict(ev, event=ev.pop("event")))
+        txt = st.render("mem:fleet")
+        assert "sim: 4 hosts" in txt
+        assert "round 5" in txt
