@@ -757,10 +757,112 @@ def run_projection(args):
     return 0
 
 
+def _check_row_key(r):
+    """Identity of a bench row across runs: workload coordinates only,
+    never measured values."""
+    return tuple(str(r.get(k)) for k in
+                 ("model", "mode", "batch", "seq_len", "d_model",
+                  "num_layers"))
+
+
+def _check_row_median(r):
+    """(median, metric_name, spread|None) for a row — the spread median
+    when recorded (best-window headline values inherit contention luck;
+    the median is the comparable number), else the headline value."""
+    for k in ("images_per_sec", "tokens_per_sec"):
+        sp = r.get(f"{k}_spread")
+        if isinstance(sp, dict) and \
+                isinstance(sp.get("median"), (int, float)):
+            return float(sp["median"]), k, sp
+        if isinstance(r.get(k), (int, float)):
+            return float(r[k]), k, None
+    return None, None, None
+
+
+def run_check(args):
+    """bench.py --check: the perf-regression gate (ISSUE 16).
+
+    Compares the rows in --details (the current run's output) against
+    the committed baseline medians (--check-baseline, default the
+    committed bench_details.json; a BASELINE.json with published rows
+    is accepted too). Per row the threshold is noise-tolerant: the
+    current median must stay above
+
+        baseline_median * (1 - max(--check-tolerance, baseline
+                                   median-to-min spread ratio))
+
+    so a workload whose committed windows already vary by 30% is not
+    gated at 15%. Any breach (or a baseline row missing from the
+    current file) fails with the offending row named; exit 1. Runs
+    without jax or an accelerator — pure JSON compare — so CI gates on
+    any machine."""
+    try:
+        with open(args.check_baseline) as f:
+            base = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"bench --check: cannot read baseline "
+              f"{args.check_baseline}: {e}", file=sys.stderr)
+        return 2
+    base_rows = base.get("rows") or base.get("published") or []
+    if isinstance(base_rows, dict):
+        base_rows = list(base_rows.values())
+    if not base_rows:
+        print(f"bench --check: baseline {args.check_baseline} has no "
+              "rows to gate against", file=sys.stderr)
+        return 2
+    try:
+        with open(args.details) as f:
+            cur = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"bench --check: cannot read current rows "
+              f"{args.details}: {e}", file=sys.stderr)
+        return 2
+    cur_by_key = {_check_row_key(r): r for r in (cur.get("rows") or [])}
+    failures, checked = [], 0
+    for br in base_rows:
+        med_b, metric, sp = _check_row_median(br)
+        if med_b is None or med_b <= 0:
+            continue
+        key = _check_row_key(br)
+        name = " ".join(k for k in key if k != "None")
+        cr = cur_by_key.get(key)
+        if cr is None:
+            failures.append(f"row MISSING from {args.details}: {name} "
+                            f"(baseline {metric} median {med_b:,.1f})")
+            continue
+        med_c, _, _ = _check_row_median(cr)
+        if med_c is None:
+            failures.append(f"row has no {metric} in {args.details}: "
+                            f"{name}")
+            continue
+        tol = args.check_tolerance
+        if sp and isinstance(sp.get("min"), (int, float)) and med_b > 0:
+            tol = max(tol, (med_b - float(sp["min"])) / med_b)
+        floor = med_b * (1.0 - tol)
+        checked += 1
+        verdict = "ok" if med_c >= floor else "REGRESSED"
+        line = (f"  {verdict:<9} {name}: {metric} median "
+                f"{med_c:,.1f} vs baseline {med_b:,.1f} "
+                f"(floor {floor:,.1f}, tol {tol:.0%})")
+        print(line, file=sys.stderr)
+        if med_c < floor:
+            failures.append(
+                f"{name}: {metric} median {med_c:,.1f} fell below "
+                f"{floor:,.1f} ({med_b:,.1f} - {tol:.0%} noise "
+                "tolerance)")
+    if failures:
+        print(f"bench --check: FAIL — {len(failures)} failing row(s), "
+              f"{checked} compared:", file=sys.stderr)
+        for fmsg in failures:
+            print(f"  {fmsg}", file=sys.stderr)
+        return 1
+    print(f"bench --check: OK — {checked} row(s) within noise "
+          f"tolerance of {args.check_baseline}", file=sys.stderr)
+    return 0
+
+
 def main():
     import argparse
-    import jax
-    from sparknet_tpu.models import zoo
     global WINDOWS
 
     ap = argparse.ArgumentParser()
@@ -782,10 +884,28 @@ def main():
     ap.add_argument("--chips", type=int, nargs="+", default=[2, 4, 8, 32])
     ap.add_argument("--ici-gbps", type=float, default=ICI_GBPS)
     ap.add_argument("--dcn-gbps", type=float, default=DCN_GBPS)
+    ap.add_argument("--check", action="store_true",
+                    help="perf-regression gate: compare the rows in "
+                         "--details against the committed baseline "
+                         "medians and exit 1 naming any row below its "
+                         "noise-tolerant floor (no jax needed)")
+    ap.add_argument("--check-baseline", default="bench_details.json",
+                    help="baseline rows for --check (committed "
+                         "bench_details.json, or a BASELINE.json with "
+                         "published rows)")
+    ap.add_argument("--check-tolerance", type=float, default=0.15,
+                    help="minimum allowed regression fraction before "
+                         "--check fails a row; widened per-row to the "
+                         "baseline's own median-to-min window spread")
     args = ap.parse_args()
     WINDOWS = max(1, args.windows)
+    if args.check:
+        raise SystemExit(run_check(args))
     if args.project:
         raise SystemExit(run_projection(args))
+
+    import jax
+    from sparknet_tpu.models import zoo
 
     # persistent compile cache: repeat bench runs skip the (minutes-long)
     # XLA compiles; keyed by HLO so code changes still recompile

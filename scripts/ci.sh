@@ -61,6 +61,21 @@ echo "fsdp smoke OK"
 bash scripts/smoke.sh simfleet || exit 1
 echo "simfleet smoke OK"
 
+# fleet observability, end to end: a real 2-process run with a chaos
+# slow_host straggler merged into one clock-aligned Chrome trace, the
+# critical path naming the straggler from the metrics alone, and the
+# simfleet cell rendering through the same path (scripts/smoke.sh
+# stage m)
+bash scripts/smoke.sh trace || exit 1
+echo "trace smoke OK"
+
+# perf-regression gate: the committed bench_details.json rows must sit
+# within their own noise tolerance of the committed medians (pure JSON
+# compare, no accelerator; a fresh bench run's rows are gated the same
+# way by `python bench.py --check --details <new rows>`)
+python bench.py --check || exit 1
+echo "bench --check OK"
+
 set -o pipefail
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu \

@@ -62,9 +62,18 @@
 #       finish under a 60 s CPU wall budget with `sparknet report` and
 #       `monitor` rendering the simulated metrics stream.
 #
-# Usage: smoke.sh [all|multihost|async|serve|ingest|fsdp|simfleet]  —
-# the named stages run alone (the fast CI wiring; scripts/ci.sh invokes
-# them individually).
+# Fleet observability (ISSUE 16):
+#   (m) a REAL 2-process relay run with a chaos slow_host straggler
+#       writes per-host metrics streams; `sparknet trace` must merge
+#       them into ONE Chrome trace with a track per host and solved
+#       clock offsets in the metadata, `--critpath` must name the
+#       injected straggler host from the metrics alone, and the same
+#       verb must render a critical-path summary for a simulated
+#       fleet cell (zero special cases between real and simfleet).
+#
+# Usage: smoke.sh [all|multihost|async|serve|ingest|fsdp|simfleet|trace]
+# — the named stages run alone (the fast CI wiring; scripts/ci.sh
+# invokes them individually).
 set -euo pipefail
 cd "$(dirname "$0")/.."
 export JAX_PLATFORMS=cpu
@@ -789,6 +798,91 @@ EOF
          "1000x200 chaos cell in ${took}s, report+monitor rendered"
 }
 
+# ---------------------------------------------- fleet observability ----
+# Cross-host trace correlation (ISSUE 16): the per-host metrics streams
+# of a real 2-process run merge into one clock-aligned timeline via the
+# heartbeat trace_align beacons, and the critical-path decomposition
+# names the chaos-injected straggler from the metrics alone.
+run_trace_stage() {
+    tr="$tmp/trace"
+    mkdir -p "$tr"
+    port=$(python -c "import socket; s=socket.socket(); \
+s.bind(('localhost',0)); print(s.getsockname()[1])")
+    pids=()
+    for i in 0 1; do
+        SPARKNET_COORDINATOR="localhost:$port" \
+        SPARKNET_NUM_PROCESSES=2 SPARKNET_PROCESS_ID=$i \
+        SPARKNET_CHAOS="slow_host=1,slow_host_s=3,slow_host_round=2" \
+        XLA_FLAGS="--xla_force_host_platform_device_count=4" \
+        python -m sparknet_tpu cifar --workers 4 --hosts 2 --tau 2 \
+            --rounds 4 --test-every 100 --metrics "$tr/run$i.jsonl" \
+            --heartbeat-dir "$tr/rdv" --lease-s 5 \
+            --heartbeat-interval 0.2 --quorum 2 \
+            > "$tr/out$i.txt" 2>&1 &
+        pids+=($!)
+    done
+    for i in 0 1; do
+        rc=0; wait "${pids[$i]}" || rc=$?
+        test "$rc" -eq 0 || { echo "trace host $i failed (rc=$rc):"
+                              cat "$tr/out$i.txt"; exit 1; }
+    done
+
+    # one merged Chrome trace: a track per host, solved clock offsets
+    python -m sparknet_tpu trace "$tr/run0.jsonl" "$tr/run1.jsonl" \
+        --chrome "$tr/fleet.json" | tee "$tr/chrome.out"
+    grep -q "2 host track(s)" "$tr/chrome.out"
+    python - "$tr" <<'EOF'
+import json, sys, os
+doc = json.load(open(os.path.join(sys.argv[1], "fleet.json")))
+names = [e for e in doc["traceEvents"]
+         if e.get("ph") == "M" and e["name"] == "process_name"]
+assert len(names) == 2, f"expected 2 host tracks, got {len(names)}"
+offs = doc["otherData"]["clock_offsets"]
+assert set(offs) == {"0", "1"}, offs
+assert all(o["aligned"] for o in offs.values()), offs
+gates = [e for e in doc["traceEvents"]
+         if e.get("ph") == "X" and e["name"].startswith("gate")]
+assert gates, "no gate events on the merged timeline"
+print(f"chrome OK: 2 aligned host tracks, offsets "
+      f"{[o['offset_s'] for o in offs.values()]}")
+EOF
+
+    # critical path: the chaos slow_host straggler named from metrics
+    python -m sparknet_tpu trace "$tr/run0.jsonl" "$tr/run1.jsonl" \
+        --critpath | tee "$tr/crit.out"
+    grep -q "blocked on host 1" "$tr/crit.out"
+    grep -q "chaos slow_host" "$tr/crit.out"
+    grep -q "host 1: blocked" "$tr/crit.out"
+
+    # report/monitor fleet sections render from the same stream, and
+    # the JSON report carries the machine-readable alignment summary
+    python -m sparknet_tpu report "$tr/run0.jsonl" | tee "$tr/rep.txt" \
+        > /dev/null
+    grep -q "fleet timeline" "$tr/rep.txt"
+    python -m sparknet_tpu report "$tr/run0.jsonl" --format json \
+        | python -c "
+import json, sys
+rep = json.load(sys.stdin)
+assert rep['fleet']['beacons'] > 0, rep.get('fleet')
+assert '0' in rep['fleet']['offsets'], rep['fleet']"
+
+    # a simulated fleet cell flows through the SAME beacon path
+    python -m sparknet_tpu simfleet --hosts 200 --rounds 30 \
+        --interval 0.2 --lease 0.6 --round_s 0.15 \
+        --chaos "slow_worker=7,slow_s=2,slow_round=10" \
+        --metrics "$tr/sim.jsonl" > "$tr/sim.out" 2>&1
+    python -m sparknet_tpu trace "$tr/sim.jsonl" --critpath \
+        | tee "$tr/simcrit.out"
+    grep -q "critical path (30 round(s)" "$tr/simcrit.out"
+    echo "trace stage OK: merged Chrome trace with per-host clock" \
+         "offsets, critpath named the chaos straggler"
+}
+
+if [ "$stage" = "trace" ]; then
+    run_trace_stage
+    echo "SMOKE OK (trace)"
+    exit 0
+fi
 if [ "$stage" = "simfleet" ]; then
     run_simfleet_stage
     echo "SMOKE OK (simfleet)"
@@ -1025,5 +1119,7 @@ run_ingest_stage
 run_fsdp_stage
 
 run_simfleet_stage
+
+run_trace_stage
 
 echo "SMOKE OK"

@@ -814,7 +814,8 @@ def cmd_report(args):
         obs_report.report_file(args.jsonl, json_out=args.json,
                                chrome_out=args.chrome,
                                since=args.since,
-                               event_types=events or None)
+                               event_types=events or None,
+                               fmt=args.format)
     except obs_report.MetricsFileError as e:
         # missing/empty/unreadable metrics is an operator error, not a
         # crash: one line on stderr, distinct exit code
@@ -844,6 +845,73 @@ def cmd_monitor(args):
         os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
         return 0
     return 0 if state.events else 2
+
+
+def cmd_trace(args):
+    """`sparknet trace`: merge N per-host metrics JSONLs into one
+    clock-aligned fleet timeline (obs/fleettrace.py). --chrome writes a
+    single Chrome trace_event file with one track group per host plus
+    the solved per-host clock offsets; --critpath renders the per-round
+    critical-path decomposition (obs/critpath.py) naming the blocking
+    host and phase; --round N limits the critpath to one round. With
+    neither flag, prints the alignment summary. Also consumes a single
+    multiplexed `sparknet simfleet --metrics` stream unchanged."""
+    import json as _json
+    from .obs import critpath as obs_critpath
+    from .obs import fleettrace as obs_fleettrace
+    from .obs.report import MetricsFileError, load_events
+    try:
+        streams, bad = [], 0
+        for path in args.metrics:
+            evs, b = load_events(path)
+            streams.append(evs)
+            bad += b
+        if not any(streams):
+            raise MetricsFileError(
+                "no parseable events in "
+                + ", ".join(args.metrics)
+                + (f" ({bad} malformed line(s) skipped)" if bad else ""))
+        ft = obs_fleettrace.merge_streams(streams)
+        if bad:
+            print(f"sparknet trace: WARNING: {bad} malformed JSONL "
+                  "line(s) skipped", file=sys.stderr)
+        if args.chrome:
+            obs_fleettrace.export_chrome(args.chrome, ft)
+            n_hosts = len(ft.hosts)
+            print(f"wrote {args.chrome} ({n_hosts} host track(s), "
+                  f"{sum(len(v) for v in ft.events.values())} event(s))")
+        if args.critpath:
+            cp = obs_critpath.compute(ft, round_filter=args.round)
+            if args.json:
+                print(_json.dumps(cp, indent=1, sort_keys=True,
+                                  default=str))
+            else:
+                obs_critpath.render(cp)
+        if not args.chrome and not args.critpath:
+            summ = obs_fleettrace.align_summary(ft)
+            if args.json:
+                print(_json.dumps(summ, indent=1, sort_keys=True))
+            else:
+                print(f"fleet: {len(summ['hosts'])} track(s), "
+                      f"{summ['beacons']} clock beacon(s)")
+                for h, o in sorted(summ["offsets"].items()):
+                    if not o.get("aligned"):
+                        print(f"  host {h}: unaligned (no beacon path)")
+                        continue
+                    err = o.get("err_s")
+                    err_txt = "one-sided bound" if err is None \
+                        else f"±{err * 1e3:.1f} ms"
+                    print(f"  host {h}: offset "
+                          f"{o.get('offset_s', 0.0) * 1e3:+.1f} ms "
+                          f"({err_txt}, {o.get('samples', 0)} "
+                          "beacon(s))")
+    except MetricsFileError as e:
+        print(f"sparknet trace: error: {e}", file=sys.stderr)
+        return 2
+    except BrokenPipeError:
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        return 0
+    return 0
 
 
 def cmd_simfleet(args):
@@ -1584,6 +1652,10 @@ def main(argv=None):
                     help="comma-separated event kinds to aggregate "
                          "(e.g. 'health,divergence'); selecting zero "
                          "events is an error (exit 2)")
+    rp.add_argument("--format", choices=("text", "json"), default="text",
+                    help="json: print the report dict itself on stdout "
+                         "(stable keys mirroring the rendered sections) "
+                         "for CI / perf-gate assertions")
     rp.set_defaults(fn=cmd_report)
 
     mo = sub.add_parser("monitor",
@@ -1602,6 +1674,28 @@ def main(argv=None):
     mo.add_argument("--duration", type=float, default=None,
                     help="stop after this many seconds (default: forever)")
     mo.set_defaults(fn=cmd_monitor)
+
+    tr = sub.add_parser(
+        "trace",
+        help="merge per-host metrics JSONLs into one clock-aligned "
+             "fleet timeline: Chrome trace export (one track per host "
+             "+ clock-offset metadata) and per-round critical-path "
+             "attribution naming the blocking host and phase")
+    tr.add_argument("metrics", nargs="+",
+                    help="metrics JSONL file(s) — one per host, or one "
+                         "multiplexed simfleet stream")
+    tr.add_argument("--chrome", metavar="OUT",
+                    help="write the merged Chrome trace_event file here")
+    tr.add_argument("--critpath", action="store_true",
+                    help="render the per-round critical-path "
+                         "decomposition (blocking host, phases, top "
+                         "blockers, comms exposure)")
+    tr.add_argument("--round", type=int, default=None, metavar="N",
+                    help="limit --critpath to round N")
+    tr.add_argument("--json", action="store_true",
+                    help="emit the critpath/alignment result as JSON "
+                         "on stdout instead of text")
+    tr.set_defaults(fn=cmd_trace)
 
     sf = sub.add_parser(
         "simfleet",

@@ -88,7 +88,7 @@ EVENTS = {
         "open": False,
     },
     'host_round': {
-        "fields": ['arrived', 'dead', 'lease_age_s', 'observer', 'round', 'wait_s'],
+        "fields": ['arrived', 'dead', 'lease_age_s', 'mono', 'observer', 'round', 'wait_s'],
         "open": False,
     },
     'ingest': {
@@ -125,6 +125,10 @@ EVENTS = {
     },
     'recovery': {
         "fields": ['attempt', 'iter', 'kind', 'loss', 'lr_decay', 'reason', 'rollbacks', 'to_iter'],
+        "open": False,
+    },
+    'relay_io': {
+        "fields": ['bytes', 'host', 'mono', 'round', 'seconds'],
         "open": False,
     },
     'reshard': {
@@ -186,6 +190,10 @@ EVENTS = {
     'test': {
         "fields": ['iter', 'metric', 'round', 'value'],
         "open": True,
+    },
+    'trace_align': {
+        "fields": ['obs_mono', 'observer', 'peer', 'peer_mono', 'peer_stamp', 'seq'],
+        "open": False,
     },
     'train': {
         "fields": ['images_per_sec', 'iter', 'loss', 'lr', 'tokens_per_sec'],

@@ -78,6 +78,13 @@ class MonitorState:
         self.coordinated_restart = None
         # fleet simulation (sim/fleet.py, per-round summary)
         self.sim = None             # last sim event
+        # fleet timeline (obs/fleettrace.py): clock-sync beacons plus
+        # per-observer gate waits of the newest round — the live
+        # blocker estimate (the full solve is `sparknet trace`)
+        self.align_beacons = 0
+        self.align_hosts = set()
+        self.gate_waits = {}        # round -> {observer: wait_s}
+        self.last_gate_round = None
         # elastic world resizing (resilience/checkpoint.py reshard)
         self.reshard = None         # last reshard event, if any
         # input pipeline (data/prefetch.py, data/ingest.py, ISSUE 13)
@@ -187,6 +194,18 @@ class MonitorState:
             self.host_gate = ev
             if isinstance(ev.get("lease_age_s"), list):
                 self.host_lease_age = ev["lease_age_s"]
+            if _num(ev.get("round")) and ev.get("observer") is not None:
+                r = int(ev["round"])
+                self.gate_waits.setdefault(r, {})[int(ev["observer"])] \
+                    = float(ev.get("wait_s") or 0.0)
+                self.last_gate_round = r
+                for old in sorted(self.gate_waits)[:-4]:
+                    del self.gate_waits[old]
+        elif kind == "trace_align":
+            self.align_beacons += 1
+            for f in ("observer", "peer"):
+                if isinstance(ev.get(f), int):
+                    self.align_hosts.add(ev[f])
         elif kind == "host_evicted":
             if ev.get("host") is not None:
                 self.host_evictions[int(ev["host"])] += 1
@@ -358,6 +377,23 @@ class MonitorState:
                 L.append("    coordinated restart "
                          + ("AGREED" if cr.get("agreed") else "DISAGREED")
                          + f" across hosts {cr.get('hosts')}")
+        waits = self.gate_waits.get(self.last_gate_round) or {}
+        if self.align_beacons or len(waits) > 1:
+            bits = []
+            if self.align_beacons:
+                bits.append(f"{self.align_beacons} clock beacon(s) over "
+                            f"{len(self.align_hosts)} host(s)")
+            if len(waits) > 1:
+                spread = max(waits.values()) - min(waits.values())
+                if spread >= 0.02:
+                    # the host that waited least entered the gate last —
+                    # everyone else's wait is its exposed straggle
+                    blk = min(sorted(waits), key=lambda h: waits[h])
+                    bits.append(f"r{self.last_gate_round} blocked on "
+                                f"host {blk} ({spread:.3f}s exposed)")
+                else:
+                    bits.append(f"r{self.last_gate_round} balanced")
+            L.append("  fleet: " + "  ".join(bits))
         if self.sim is not None:
             s = self.sim
             bits = [f"{s.get('hosts')} hosts",

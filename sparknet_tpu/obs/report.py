@@ -276,6 +276,18 @@ def aggregate(events):
         fl.update({f"wait_s_{k}": round(v, 4)
                    for k, v in percentiles(waits).items()})
         rep["simulation"] = fl
+    # fleet timeline (obs/fleettrace + obs/critpath): clock-beacon
+    # alignment plus per-round critical-path attribution whenever the
+    # stream carries mono-stamped events (trace_align beacons or
+    # mono-bearing host_round gate exits)
+    ta = [e for e in events if e.get("event") == "trace_align"]
+    if ta or any(_num(e.get("mono")) for e in hr):
+        from . import critpath as _critpath
+        from . import fleettrace as _fleettrace
+        ft = _fleettrace.merge_streams([events])
+        fleet = _fleettrace.align_summary(ft)
+        fleet["critpath"] = _critpath.compute(ft)["summary"]
+        rep["fleet"] = fleet
     # bounded staleness (the async local-SGD mode): per-worker version
     # lag / park-time accounting + drift attribution
     st = [e for e in events if e.get("event") == "staleness"]
@@ -752,6 +764,34 @@ def render(rep):
             L.append("  gate wait " + "  ".join(
                 f"{q}={ps[q]:.3f}s" for q in ("p50", "p95", "p99")
                 if _num(ps[q])))
+    ftl = rep.get("fleet")
+    if ftl:
+        hdr("fleet timeline")
+        L.append(f"  {len(ftl.get('hosts', []))} track(s), "
+                 f"{ftl.get('beacons', 0)} clock beacon(s)")
+        for h, o in sorted(ftl.get("offsets", {}).items()):
+            if not o.get("aligned"):
+                L.append(f"    host {h}: unaligned (no beacon path)")
+                continue
+            err = o.get("err_s")
+            err_txt = "one-sided bound" if err is None \
+                else f"±{err * 1e3:.1f} ms"
+            L.append(f"    host {h}: offset "
+                     f"{o.get('offset_s', 0.0) * 1e3:+.1f} ms "
+                     f"({err_txt}, {o.get('samples', 0)} beacon(s))")
+        cps = ftl.get("critpath") or {}
+        if cps.get("rounds"):
+            L.append(f"  critical path over {cps['rounds']} round(s), "
+                     f"{cps.get('wall_s', 0)}s wall")
+            pt = cps.get("phase_totals") or {}
+            split = ", ".join(f"{k} {v}s" for k, v in sorted(pt.items())
+                              if _num(v) and v > 0)
+            if split:
+                L.append(f"    phase totals: {split}")
+            for b in cps.get("top_blockers", []):
+                L.append(f"    blocker host {b['host']}: "
+                         f"{b['rounds_blocked']} round(s), "
+                         f"{b['exposed_s']}s exposed")
     if any(rep.get(k) for k in ("divergence", "health", "memstats")):
         hdr("training health")
         d = rep.get("divergence")
@@ -930,12 +970,17 @@ def filter_events(events, since=None, event_types=None):
 
 
 def report_file(jsonl_path, json_out=None, chrome_out=None, out=print,
-                since=None, event_types=None):
+                since=None, event_types=None, fmt="text"):
     """Load + aggregate + render; optionally write JSON / Chrome trace.
     The implementation behind `sparknet report`. ``since``/
     ``event_types`` select a slice of the stream; a selection that
     matches ZERO events raises MetricsFileError (exit 2 at the CLI) —
-    never an empty report that reads as "all healthy"."""
+    never an empty report that reads as "all healthy".
+
+    ``fmt="json"`` emits the report dict itself on stdout (sorted keys,
+    one stable document — the same keys --json writes) so CI and the
+    bench perf gate can assert on report content without scraping the
+    rendered text."""
     events, bad = load_events(jsonl_path)
     if not events:
         raise MetricsFileError(
@@ -959,14 +1004,19 @@ def report_file(jsonl_path, json_out=None, chrome_out=None, out=print,
     rep = aggregate(events)
     if bad:
         rep["malformed_lines"] = bad
-    out(render(rep))
+    if fmt == "json":
+        out(json.dumps(rep, indent=1, sort_keys=True, default=str))
+    else:
+        out(render(rep))
     if json_out:
         with open(json_out, "w") as f:
             json.dump(rep, f, indent=1, default=str)
-        out(f"wrote {json_out}")
+        if fmt != "json":
+            out(f"wrote {json_out}")
     if chrome_out:
         from .trace import export_chrome
         spans = [e for e in events if e.get("event") == "span"]
         export_chrome(chrome_out, spans)
-        out(f"wrote {chrome_out} ({len(spans)} spans)")
+        if fmt != "json":
+            out(f"wrote {chrome_out} ({len(spans)} spans)")
     return rep

@@ -1,4 +1,5 @@
-"""Span tracer: nested wall-clock spans over the JSONL metrics stream.
+"""Span tracer: nested monotonic-clock spans over the JSONL metrics
+stream.
 
 Each completed span emits one ``span`` event (name, start/duration in ms
 relative to the tracer epoch, nesting depth, parent span name, thread id,
@@ -8,6 +9,12 @@ The tracer also keeps a bounded in-memory buffer of completed spans for
 Chrome ``trace_event`` export — load the file in chrome://tracing or
 Perfetto next to a jax.profiler device trace.
 
+Timestamps come from the injectable time seam (resilience.seam.Clock),
+on its MONOTONIC source: an NTP step or suspend/resume mid-run cannot
+fold spans over each other (the same fix PR 15 applied to lease ages),
+and a simulated run can hand the tracer a SimClock so spans land on the
+virtual timeline the fleet merger aligns against.
+
 ``JaxProfiler`` packages the steady-state one-block device-trace toggle
 that used to live inline in cli.cmd_train.
 """
@@ -15,17 +22,19 @@ that used to live inline in cli.cmd_train.
 import json
 import os
 import threading
-import time
 from contextlib import contextmanager
+
+from ..resilience.seam import WALL_CLOCK
 
 
 class Tracer:
     """Nested spans over a MetricsLogger sink (sink=None -> spans still
     nest and buffer for Chrome export, nothing hits the JSONL)."""
 
-    def __init__(self, sink=None, max_buffer=100_000):
+    def __init__(self, sink=None, max_buffer=100_000, clock=None):
         self.sink = sink
-        self.t0 = time.perf_counter()
+        self.clock = clock if clock is not None else WALL_CLOCK
+        self.t0 = self.clock.monotonic()
         self._tls = threading.local()
         self._lock = threading.Lock()
         self._buf = []              # spk: guarded-by=_lock
@@ -45,12 +54,12 @@ class Tracer:
         st = self._stack()
         parent = st[-1] if st else None
         st.append(name)
-        start = time.perf_counter() - self.t0
+        start = self.clock.monotonic() - self.t0
         try:
             yield attrs
         finally:
             st.pop()
-            end = time.perf_counter() - self.t0
+            end = self.clock.monotonic() - self.t0
             rec = {"name": name, "start_ms": round(start * 1e3, 3),
                    "dur_ms": round((end - start) * 1e3, 3),
                    "depth": len(st), "parent": parent,
@@ -61,7 +70,7 @@ class Tracer:
     def instant(self, name, **attrs):
         """A zero-duration mark (Chrome 'instant' event)."""
         rec = {"name": name,
-               "start_ms": round((time.perf_counter() - self.t0) * 1e3, 3),
+               "start_ms": round((self.clock.monotonic() - self.t0) * 1e3, 3),
                "dur_ms": 0.0, "depth": len(self._stack()),
                "parent": self._stack()[-1] if self._stack() else None,
                "tid": threading.get_ident()}

@@ -995,8 +995,19 @@ class LocalSGDSolver(Solver):
             all(np.all(np.isfinite(x)) for x in payload)
         alive = self.elastic.live() if self.elastic is not None \
             else list(range(self.heartbeat.n))
+        xt0 = _t.perf_counter()
         consensus, aux = self._relay.exchange(
             self._round_idx, payload, valid, local_loss, alive)
+        if self.metrics is not None:
+            # the cross-host IO tier, timed on its own: the fleet
+            # merger renders this as the consensus/relay track and
+            # critpath.py splits it out of the round's wall time
+            self.metrics.log(
+                "relay_io", round=self._round_idx,
+                host=self.heartbeat.host,
+                seconds=round(_t.perf_counter() - xt0, 4),
+                bytes=int(sum(x.nbytes for x in payload)),
+                mono=self.heartbeat.clock.monotonic())
         np_ = len(leaves_p)
         ns = np_ + len(leaves_s)
         self.params = jax.tree_util.tree_unflatten(tdef_p, consensus[:np_])

@@ -196,6 +196,10 @@ class HeartbeatCoordinator:
         # initial age). Freshness is monotonic from the receipt, so a
         # wall-clock step can never mass-expire peers (ISSUE 15).
         self._lease_seen = {}                        # spk: guarded-by=_lock
+        # trace_align throttle: host -> observer mono of the last beacon
+        # emitted for that peer (at most one per lease_s per peer keeps
+        # the metrics volume O(hosts / lease_s) even at sim scale)
+        self._align_last = {}                        # spk: guarded-by=_lock
         self._t0_mono = self.clock.monotonic()
         self._stop = threading.Event()
         self._thread = None
@@ -224,13 +228,23 @@ class HeartbeatCoordinator:
         view()/gate() readers on the state lock (`sparknet lint`
         SPK206). Two interleaved beats may land out of order; the loser
         differs by one seq and a stamp milliseconds older — noise far
-        below lease_s, and the writer re-leases every interval_s."""
+        below lease_s, and the writer re-leases every interval_s.
+
+        The record carries BOTH time bases: ``stamp`` (wall, the only
+        cross-process base a shared directory offers) and ``mono``
+        (this host's monotonic clock) — the send half of a sync beacon.
+        A peer that observes the new record pairs ``mono`` with its own
+        monotonic receipt time (a ``trace_align`` event), which is what
+        obs/fleettrace.py solves per-host clock offsets from. Readers
+        use .get(): beats from older builds without ``mono`` stay
+        readable, they just contribute no beacon."""
         with self._lock:
             if self._stopped:
                 return
             self._seq += 1
             rec = {"host": self.host, "seq": self._seq,
-                   "round": self._round, "stamp": self.clock.time()}
+                   "round": self._round, "stamp": self.clock.time(),
+                   "mono": self.clock.monotonic()}
         self.dirops.write_json(self._hb_name(self.host), rec)
 
     def announce_round(self, round_idx):
@@ -382,6 +396,7 @@ class HeartbeatCoordinator:
                 if self._peer_visible(h, round_idx) else None
         alive = np.zeros(n, bool)
         age = np.full(n, np.inf, np.float64)
+        beacons = []
         with self._lock:
             for h in range(n):
                 if h == self.host:
@@ -407,8 +422,31 @@ class HeartbeatCoordinator:
                         if seen is None else 0.0
                     seen = (key, mono, init)
                     self._lease_seen[h] = seen
+                    # a fresh receipt is a clock-sync beacon: the
+                    # sender's (stamp, mono) paired with OUR monotonic
+                    # receipt time bounds the pairwise clock offset
+                    # (obs/fleettrace.py). Old-format beats carry no
+                    # mono and contribute nothing. Throttled per peer;
+                    # emitted after the lock drops (SPK206).
+                    if self.metrics is not None and \
+                            isinstance(rec.get("mono"), (int, float)):
+                        last = self._align_last.get(h)
+                        if last is None or mono - last >= self.lease_s:
+                            self._align_last[h] = mono
+                            beacons.append(
+                                {"observer": self.host, "peer": h,
+                                 "seq": int(rec.get("seq") or 0),
+                                 "peer_mono": float(rec["mono"]),
+                                 "peer_stamp":
+                                     float(rec.get("stamp", 0.0)),
+                                 "obs_mono": mono})
                 age[h] = seen[2] + (mono - seen[1])
                 alive[h] = age[h] <= self.lease_s
+        for b in beacons:
+            self.metrics.log(
+                "trace_align", observer=b["observer"], peer=b["peer"],
+                seq=b["seq"], peer_mono=b["peer_mono"],
+                peer_stamp=b["peer_stamp"], obs_mono=b["obs_mono"])
         return alive, age
 
     def _refresh_view(self):                 # spk: thread-entry
@@ -561,9 +599,13 @@ class HeartbeatCoordinator:
             with self._lock:
                 self._ever_dead |= dead
         if self.metrics is not None:
+            # mono: gate-exit time on this host's monotonic clock —
+            # lets the fleet merger place the wait exactly on the
+            # aligned timeline instead of via the wall-t fallback
             self.metrics.log("host_round", round=round_idx,
                              observer=self.host,
                              wait_s=round(res.wait_s, 4),
+                             mono=self.clock.monotonic(),
                              arrived=res.arrived, dead=res.dead,
                              lease_age_s=self.lease_ages())
         for h in res.dead:
