@@ -39,6 +39,14 @@ echo "resize smoke OK"
 bash scripts/smoke.sh serve || exit 1
 echo "serve smoke OK"
 
+# serving fleet, end to end: a real 3-replica fleet behind `sparknet
+# route` — SIGKILLed replica evicted on lease expiry with the
+# availability dip bounded (asserted from the metrics stream), grow
+# admission under load, canary auto-rollback of a corrupt checkpoint,
+# router drained on SIGTERM with exit 0 (scripts/smoke.sh stage n)
+bash scripts/smoke.sh routefleet || exit 1
+echo "routefleet smoke OK"
+
 # input pipeline, end to end: a real 2-process run whose per-host
 # `ingest` events stay inside each host's owned record shard, and a
 # --echo 2 run beating the no-echo wall clock under chaos slow_h2d

@@ -71,7 +71,17 @@
 #       verb must render a critical-path summary for a simulated
 #       fleet cell (zero special cases between real and simfleet).
 #
-# Usage: smoke.sh [all|multihost|async|serve|ingest|fsdp|simfleet|trace]
+# Serving fleet (ISSUE 17):
+#   (n) a REAL 3-replica fleet behind `sparknet route`: chaos SIGKILLs
+#       replica 1 mid-load — evicted on lease expiry with the
+#       availability dip bounded (both asserted from the metrics
+#       stream); the SLO autoscaler emits a grow decision under load
+#       and the admitted 4th replica serves a corrupt checkpoint that
+#       the canary controller auto-rolls back, pinning the baseline;
+#       the router drains on SIGTERM with exit 0.
+#
+# Usage: smoke.sh
+#   [all|multihost|async|serve|routefleet|ingest|fsdp|simfleet|trace]
 # — the named stages run alone (the fast CI wiring; scripts/ci.sh
 # invokes them individually).
 set -euo pipefail
@@ -345,6 +355,225 @@ assert h['iter'] == 5, h"
         | grep -q "serving: requests"
     echo "serve stage OK: bench clean across a live hot reload," \
          "SIGTERM drained with exit 0, report rendered the section"
+}
+
+# ------------------------------------------------- serving fleet ----
+# (n) routing tier over a REAL 3-replica fleet (ISSUE 17): replicas
+#     lease into the rendezvous, `sparknet route` spreads POST /predict
+#     by queue depth. Chaos SIGKILLs replica 1 after its 25th request —
+#     the router must evict it on lease expiry with the availability
+#     dip bounded, both asserted FROM THE METRICS STREAM. The SLO
+#     autoscaler must emit a grow decision under load; the script
+#     (acting as the orchestrator) launches replica 3 — admitted via
+#     the grow path — serving a CORRUPT canary checkpoint (wrong feed
+#     width, so canary-routed requests 400): the canary controller
+#     must auto-rollback, pin traffic to the baseline sha, and a
+#     post-rollback bench must run clean on the old weights. SIGTERM
+#     drains the router with exit 0; report/monitor render the
+#     routing section from the same stream.
+run_routefleet_stage() {
+    rf="$tmp/routefleet"
+    rdv="$rf/rdv"
+    mkdir -p "$rf" "$rdv"
+
+    python - "$rf" <<'EOF'
+# snapshot A (8-wide feeds, the baseline) and snapshot B (6-wide
+# feeds: the "corrupt" canary — requests shaped for A get 400 from it)
+import sys
+import numpy as np
+from sparknet_tpu.proto import Message
+from sparknet_tpu.solver import Solver
+
+def mlp(feat):
+    net = Message("NetParameter", name="mlp")
+    net.add("layer", name="d", type="JavaData", top=["data"],
+            java_data_param=dict(shape=dict(dim=[16, feat])))
+    net.add("layer", name="l", type="JavaData", top=["label"],
+            java_data_param=dict(shape=dict(dim=[16])))
+    net.add("layer", name="fc1", type="InnerProduct", bottom=["data"],
+            top=["fc1"], inner_product_param=dict(
+                num_output=16, weight_filler=dict(type="xavier")))
+    net.add("layer", name="r1", type="ReLU", bottom=["fc1"], top=["fc1"])
+    net.add("layer", name="fc2", type="InnerProduct", bottom=["fc1"],
+            top=["fc2"], inner_product_param=dict(
+                num_output=4, weight_filler=dict(type="xavier")))
+    net.add("layer", name="loss", type="SoftmaxWithLoss",
+            bottom=["fc2", "label"], top=["loss"])
+    return net
+
+for name, feat in (("snapA", 8), ("snapB", 6)):
+    sp = Message("SolverParameter", base_lr=0.1, lr_policy="fixed",
+                 momentum=0.9, random_seed=7)
+    s = Solver(sp, net_param=mlp(feat), log_fn=None)
+    rs = np.random.RandomState(0)
+    for _ in range(3):
+        s.train_step({"data": rs.randn(16, feat).astype(np.float32),
+                      "label": rs.randint(0, 4, 16).astype(np.int32)})
+    s.snapshot(sys.argv[1] + "/" + name)
+print("routefleet stage: snapshots A (baseline) + B (corrupt canary)")
+EOF
+
+    rpids=()
+    for i in 0 1 2; do
+        chaos=""
+        [ "$i" = 1 ] && chaos="kill_replica=1,kill_req=25"
+        python -m sparknet_tpu serve --prefix "$rf/snapA" --port 0 \
+            --fleet_dir "$rdv" --replica "$i" --replicas 3 \
+            --lease 2 --heartbeat_interval 0.3 \
+            ${chaos:+--chaos "$chaos"} \
+            --metrics "$rf/rep$i.jsonl" > "$rf/rep$i.out" 2>&1 &
+        rpids+=($!)
+    done
+    for i in 0 1 2; do
+        for _ in $(seq 1 120); do
+            grep -q "listening on" "$rf/rep$i.out" && break
+            kill -0 "${rpids[$i]}" || { echo "replica $i died at start:"
+                                        cat "$rf/rep$i.out"; exit 1; }
+            sleep 0.5
+        done
+    done
+
+    python -m sparknet_tpu route --fleet_dir "$rdv" --replicas 3 \
+        --lease 2 --window_s 0.5 --slo_p99_ms 1 --breach_windows 3 \
+        --idle_windows 9999 --max_replicas 4 \
+        --canary_pct 25 --canary_min_requests 8 \
+        --metrics "$rf/route.jsonl" > "$rf/route.out" 2>&1 &
+    route_pid=$!
+    for _ in $(seq 1 120); do
+        grep -q "sparknet route: listening on" "$rf/route.out" && break
+        kill -0 "$route_pid" || { echo "router died during startup:"
+                                  cat "$rf/route.out"; exit 1; }
+        sleep 0.5
+    done
+    url=$(sed -n 's/.*listening on \(http:\/\/[^ ]*\).*/\1/p' \
+          "$rf/route.out" | head -1)
+    test -n "$url" || { echo "router never announced:"
+                        cat "$rf/route.out"; exit 1; }
+
+    # phase 1: closed-loop load through the router; chaos SIGKILLs
+    # replica 1 after its 25th served request, mid-load
+    python -m sparknet_tpu serve-bench --url "$url" --mode closed \
+        --concurrency 4 --duration 8 --json "$rf/bench1.json" \
+        > "$rf/bench1.out" 2>&1 || { echo "phase-1 bench failed:"
+                                     cat "$rf/bench1.out"; exit 1; }
+    rc=0; wait "${rpids[1]}" 2>/dev/null || rc=$?
+    test "$rc" -ne 0 || { echo "chaos target replica 1 was supposed" \
+                               "to die"; exit 1; }
+    for _ in $(seq 1 60); do
+        grep -q "EVICTED replica 1" "$rf/route.out" && break
+        sleep 0.5
+    done
+    grep -q "EVICTED replica 1" "$rf/route.out" || {
+        echo "replica 1 never evicted:"; cat "$rf/route.out"; exit 1; }
+
+    # the failover contract, asserted FROM THE METRICS STREAM: the
+    # eviction record names lease_expired, and the availability dip is
+    # bounded — in-flight casualties were retried on the survivors
+    python - "$rf" <<'EOF'
+import json, sys
+evs = [json.loads(l) for l in open(sys.argv[1] + "/route.jsonl")]
+ev = [e for e in evs if e["event"] == "eviction"]
+assert any(e["worker"] == 1 and e["reason"] == "lease_expired"
+           for e in ev), ev
+routes = [e for e in evs if e["event"] == "route"]
+ok = sum(1 for e in routes if e["code"] == 200)
+hard = sum(1 for e in routes if e["code"] not in (200, 429))
+assert routes, "no route events in the metrics stream"
+assert ok / len(routes) >= 0.95, (ok, len(routes))
+assert hard <= 8, f"availability dip not bounded: {hard} hard failures"
+retried = sum(1 for e in routes if e.get("retried"))
+print(f"routefleet failover OK: {len(routes)} dispatches, {ok} ok, "
+      f"{hard} hard failures, {retried} retried, eviction in stream")
+EOF
+
+    # phase 2: the autoscaler's grow decision is the orchestrator
+    # contract — wait for it, then launch replica 3 (the 4th), which
+    # serves the CORRUPT snapshot B: admission via the grow path AND
+    # the canary split start in one move
+    for _ in $(seq 1 60); do
+        grep -q "route: scale grow" "$rf/route.out" && break
+        sleep 0.5
+    done
+    grep -q "route: scale grow" "$rf/route.out" || {
+        echo "no grow decision:"; cat "$rf/route.out"; exit 1; }
+    python -m sparknet_tpu serve --prefix "$rf/snapB" --port 0 \
+        --fleet_dir "$rdv" --replica 3 --replicas 4 \
+        --lease 2 --heartbeat_interval 0.3 \
+        --metrics "$rf/rep3.jsonl" > "$rf/rep3.out" 2>&1 &
+    rep3_pid=$!
+    for _ in $(seq 1 60); do
+        grep -q "ADMITTED replica 3" "$rf/route.out" && break
+        sleep 0.5
+    done
+    grep -q "ADMITTED replica 3" "$rf/route.out" || {
+        echo "replica 3 never admitted:"; cat "$rf/route.out"; exit 1; }
+
+    # load with the canary live: every 4th request routes to snapshot
+    # B and 400s — the bench SEES those errors (non-zero exit is
+    # expected here); the controller must roll back and pin the
+    # baseline
+    python -m sparknet_tpu serve-bench --url "$url" --mode closed \
+        --concurrency 4 --duration 8 --json "$rf/bench2.json" \
+        > "$rf/bench2.out" 2>&1 || true
+    grep -q "serve-bench\[closed\]" "$rf/bench2.out" || {
+        echo "phase-2 bench never ran:"; cat "$rf/bench2.out"; exit 1; }
+    for _ in $(seq 1 60); do
+        grep -q "canary_rollback" "$rf/route.out" && break
+        sleep 0.5
+    done
+    grep -q "canary_rollback" "$rf/route.out" || {
+        echo "no canary rollback:"; cat "$rf/route.out"; exit 1; }
+
+    # phase 3: post-rollback the fleet serves the OLD weights clean —
+    # zero errors, zero rejects
+    python -m sparknet_tpu serve-bench --url "$url" --mode closed \
+        --concurrency 4 --duration 4 --json "$rf/bench3.json" \
+        > "$rf/bench3.out" 2>&1 || { echo "phase-3 bench failed:"
+                                     cat "$rf/bench3.out"; exit 1; }
+    python - "$rf" <<'EOF'
+import json, sys
+rf = sys.argv[1]
+b = next(r for r in json.load(open(rf + "/bench3.json"))
+         if r["mode"] == "closed")
+assert b["ok"] > 0 and b["errors"] == 0 and b["rejected"] == 0, b
+evs = [json.loads(l) for l in open(rf + "/route.jsonl")]
+scale = [e for e in evs if e["event"] == "scale"]
+assert any(e["action"] == "grow" for e in scale), scale
+adm = [e for e in evs if e["event"] == "membership"
+       and e.get("kind") == "admission"]
+assert any(e["worker"] == 3 and e.get("via") == "grow" for e in adm), adm
+can = [e for e in evs if e["event"] == "canary"]
+assert any(e["action"] == "start" for e in can), can
+rb = [e for e in can if e["action"] == "rollback"]
+assert len(rb) == 1 and rb[0]["sha"] != rb[0]["baseline_sha"], can
+print(f"routefleet canary OK: rollback of {rb[0]['sha'][:12]} pinned "
+      f"baseline {rb[0]['baseline_sha'][:12]}; post-rollback bench "
+      f"{b['ok']} ok / 0 errors")
+EOF
+
+    kill -TERM "$route_pid"
+    rc=0; wait "$route_pid" || rc=$?
+    test "$rc" -eq 0 || { echo "router SIGTERM drain exited $rc:"
+                          cat "$rf/route.out"; exit 1; }
+    grep -q "route: drained cleanly" "$rf/route.out"
+    for p in "${rpids[0]}" "${rpids[2]}" "$rep3_pid"; do
+        kill -TERM "$p" 2>/dev/null || true
+    done
+    for p in "${rpids[0]}" "${rpids[2]}" "$rep3_pid"; do
+        rc=0; wait "$p" || rc=$?
+        test "$rc" -eq 0 || { echo "replica SIGTERM drain exited $rc"
+                              exit 1; }
+    done
+
+    python -m sparknet_tpu report "$rf/route.jsonl" \
+        | tee "$rf/route.rep" > /dev/null
+    grep -q "routing fleet" "$rf/route.rep"
+    grep -q "canary" "$rf/route.rep"
+    python -m sparknet_tpu monitor "$rf/route.jsonl" --once \
+        | grep -q "routing: dispatches"
+    echo "routefleet stage OK: lease eviction + bounded-availability" \
+         "failover from the metrics stream, grow admission under load," \
+         "canary auto-rollback to the baseline, router drained exit 0"
 }
 
 # --------------------------------------- elastic world resizing ----
@@ -906,6 +1135,11 @@ fi
 if [ "$stage" = "serve" ]; then
     run_serve_stage
     echo "SMOKE OK (serve)"
+    exit 0
+fi
+if [ "$stage" = "routefleet" ]; then
+    run_routefleet_stage
+    echo "SMOKE OK (routefleet)"
     exit 0
 fi
 if [ "$stage" = "multihost" ]; then

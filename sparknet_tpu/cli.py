@@ -919,19 +919,80 @@ def cmd_simfleet(args):
     (sparknet_tpu.sim) — thousands of virtual hosts driving the REAL
     heartbeat/consensus/elastic-policy code against a simulated clock
     and in-memory rendezvous dir. One run, a --sweep grid, or the
-    replay-validation pair (--record_real / --replay). Exit 0 on
-    success, 1 on a replay mismatch, 2 on a bad chaos/sweep spec, 4
-    (EXIT_QUORUM_LOST) when the simulated fleet loses quorum — the
-    same exit a real run would take."""
+    replay-validation pair (--record_real / --replay). With --serve,
+    the SERVING-fleet simulator instead (sim/servefleet.py): virtual
+    replicas + the real router under open-loop arrival traces. Exit 0
+    on success, 1 on a replay mismatch or a lost serving request
+    (no-lost-request-without-429 invariant), 2 on a bad chaos/sweep
+    spec, 4 (EXIT_QUORUM_LOST) when the simulated fleet loses quorum —
+    the same exit a real run would take."""
     import json as _json
     import tempfile
     from .utils.exit_codes import EXIT_QUORUM_LOST
     from .utils.metrics import MetricsLogger
-    from .sim import FleetSim, replay, sweep
+    from .sim import FleetSim, ServeFleetSim, replay, sweep
 
     metrics = MetricsLogger(args.metrics) if args.metrics else None
     log = print if args.verbose else None
     try:
+        if args.serve:
+            if args.sweep:
+                cells = []
+                for spec in args.sweep:
+                    cells.extend(sweep.parse_serve_grid(spec))
+                results = sweep.run_sweep(cells, metrics=metrics,
+                                          log_fn=print,
+                                          budget_s=args.budget_s,
+                                          cell_fn=sweep.run_serve_cell)
+                print(sweep.render_serve_table(results))
+                if args.json:
+                    with open(args.json, "w") as f:
+                        _json.dump(results, f, indent=1)
+                lost = sum(r["lost"] for r in results)
+                if lost:
+                    print(f"sparknet simfleet: {lost} request(s) LOST "
+                          "without an explicit 429/5xx — the serving "
+                          "invariant is broken", file=sys.stderr)
+                    return 1
+                return 0
+            sim = ServeFleetSim(
+                replicas=args.replicas, windows=args.windows,
+                window_s=args.window_s, interval_s=args.interval,
+                lease_s=args.lease, service_ms=args.service_ms,
+                queue_limit=args.queue_limit, rate=args.rate,
+                trace=args.trace, spike_x=args.spike_x,
+                slo_p99_ms=args.slo_p99_ms, slo_depth=args.slo_depth,
+                breach_windows=args.breach_windows,
+                idle_windows=args.idle_windows,
+                max_replicas=args.max_replicas, canary_w=args.canary_w,
+                canary_pct=args.canary_pct, canary_err=args.canary_err,
+                canary_min_requests=args.canary_min_requests,
+                die_w=args.die_w, rejoin_w=args.rejoin_w,
+                chaos=args.chaos, seed=args.seed, metrics=metrics,
+                log_fn=log)
+            s = sim.run()
+            print(f"servefleet: {s['replicas']} replicas x "
+                  f"{s['windows']} windows (sim {s['sim_s']}s) "
+                  f"trace={s['trace']} rate={s['rate']:g}/s "
+                  f"lease={s['lease_s']:g} interval={s['interval_s']:g}")
+            print(f"traffic: {s['arrivals']} arrivals -> {s['ok']} ok, "
+                  f"{s['rejected']} rejected (429), {s['errors']} "
+                  f"errors, {s['retries']} retried; lost {s['lost']}")
+            print(f"availability {s['availability']}  "
+                  f"p99 {s['p99_ms']}ms")
+            print(f"membership: {s['evictions']} evictions, "
+                  f"{s['readmissions']} readmissions, "
+                  f"{s['admissions']} admissions; final live "
+                  f"{s['replicas_final']}; grow {s['grow']} shrink "
+                  f"{s['shrink']}; canary rollbacks "
+                  f"{s['canary_rollbacks']}"
+                  + ("  QUORUM LOST" if s["quorum_lost"] else ""))
+            if args.json:
+                with open(args.json, "w") as f:
+                    _json.dump(s, f, indent=1)
+            if s["quorum_lost"]:
+                return EXIT_QUORUM_LOST
+            return 1 if s["lost"] else 0
         if args.record_real:
             with tempfile.TemporaryDirectory() as d:
                 rec = replay.record_real(
@@ -1018,7 +1079,9 @@ def cmd_serve(args):
     checkpoint prefix — continuous batching, hot reload, graceful
     drain. Exit 0 after a clean SIGTERM/SIGINT drain; exit 3
     (EXIT_RECOVERY_ABORT) when the checkpoint has no servable model
-    blob, before the socket ever opens."""
+    blob, before the socket ever opens; exit 2 on a bad --chaos spec.
+    With --fleet_dir the replica leases into the fleet rendezvous
+    (serve/fleet.py) for `sparknet route` to discover."""
     from .utils.signals import SignalPolicy
     from .utils.metrics import MetricsLogger
     from .utils.exit_codes import EXIT_RECOVERY_ABORT
@@ -1030,6 +1093,16 @@ def cmd_serve(args):
         from .proto import text_format
         net_param = text_format.load(args.model, "NetParameter")
     metrics = MetricsLogger(args.metrics) if args.metrics else None
+    chaos = None
+    if args.chaos:
+        from .resilience.chaos import ChaosMonkey
+        try:
+            chaos = ChaosMonkey.parse(args.chaos, metrics=metrics)
+        except ValueError as e:
+            print(f"sparknet serve: error: {e}", file=sys.stderr)
+            if metrics:
+                metrics.close()
+            return 2
     engine = ServeEngine(args.prefix, net_param=net_param,
                          max_batch=args.max_batch, metrics=metrics)
     try:
@@ -1044,12 +1117,57 @@ def cmd_serve(args):
     batcher = Batcher(max_batch=args.max_batch,
                       max_wait_s=args.max_wait_ms / 1e3,
                       queue_limit=args.queue_limit, metrics=metrics)
+    member = None
+    if args.fleet_dir:
+        from .serve import ReplicaMember
+        member = ReplicaMember(args.fleet_dir, args.replica,
+                               replicas=args.replicas, engine=engine,
+                               batcher=batcher,
+                               interval_s=args.heartbeat_interval,
+                               lease_s=args.lease, metrics=metrics)
     # SIGTERM = the scheduler's preemption notice -> drain, exit 0
     policy = SignalPolicy(sigint="stop", sighup="none", sigterm="stop")
     with policy:
         rc = serve_http(engine, batcher, host=args.host, port=args.port,
                         metrics=metrics, policy=policy,
                         reload_poll_s=args.reload_poll,
+                        request_timeout_s=args.request_timeout,
+                        member=member, chaos=chaos,
+                        replica=args.replica)
+    if metrics:
+        metrics.close()
+    return rc
+
+
+def cmd_route(args):
+    """`sparknet route`: the serving-fleet router (serve/fleet.py) —
+    lease-based membership over --fleet_dir, least-queue-depth dispatch
+    with retry-once failover, SLO autoscaling decisions, canary
+    auto-rollback. Exit 0 after a clean SIGTERM/SIGINT drain."""
+    from .utils.signals import SignalPolicy
+    from .utils.metrics import MetricsLogger
+    from .serve import (Router, SLOAutoscaler, CanaryController,
+                        route_http)
+
+    metrics = MetricsLogger(args.metrics) if args.metrics else None
+    canary = CanaryController(
+        pct=args.canary_pct, min_requests=args.canary_min_requests,
+        max_err_delta=args.canary_err_delta,
+        max_p99_delta_ms=args.canary_p99_delta_ms, metrics=metrics)
+    router = Router(args.fleet_dir, replicas=args.replicas,
+                    lease_s=args.lease, canary=canary, metrics=metrics)
+    autoscaler = None
+    if not args.no_autoscale:
+        autoscaler = SLOAutoscaler(
+            p99_ms=args.slo_p99_ms, depth=args.slo_depth,
+            windows=args.breach_windows, idle_windows=args.idle_windows,
+            min_replicas=args.min_replicas,
+            max_replicas=args.max_replicas, metrics=metrics)
+    policy = SignalPolicy(sigint="stop", sighup="none", sigterm="stop")
+    with policy:
+        rc = route_http(router, autoscaler=autoscaler, host=args.host,
+                        port=args.port, window_s=args.window_s,
+                        policy=policy,
                         request_timeout_s=args.request_timeout)
     if metrics:
         metrics.close()
@@ -1774,6 +1892,60 @@ def main(argv=None):
                          "exactly")
     sf.add_argument("-v", "--verbose", action="store_true",
                     help="log the simulated fleet's membership story")
+    # -- the SERVING-fleet simulator (sim/servefleet.py) --
+    sf.add_argument("--serve", action="store_true",
+                    help="simulate the serving fleet instead: virtual "
+                         "replicas + the REAL router/autoscaler/canary "
+                         "under open-loop arrival traces; exit 1 when "
+                         "any request is lost without an explicit "
+                         "429/5xx")
+    sf.add_argument("--replicas", type=int, default=3,
+                    help="(--serve) initial replica count")
+    sf.add_argument("--windows", type=int, default=30,
+                    help="(--serve) router windows to simulate")
+    sf.add_argument("--window_s", type=float, default=1.0,
+                    help="(--serve) router window, simulated seconds")
+    sf.add_argument("--service_ms", type=float, default=20.0,
+                    help="(--serve) per-request service time")
+    sf.add_argument("--queue_limit", type=int, default=64,
+                    help="(--serve) per-replica queue bound (429 past "
+                         "it)")
+    sf.add_argument("--rate", type=float, default=40.0,
+                    help="(--serve) base arrival rate, req/s")
+    sf.add_argument("--trace",
+                    choices=("flat", "diurnal", "spike", "flash"),
+                    default="flat",
+                    help="(--serve) open-loop arrival shape")
+    sf.add_argument("--spike_x", type=float, default=4.0,
+                    help="(--serve) spike/flash rate multiplier")
+    sf.add_argument("--slo_p99_ms", type=float, default=500.0,
+                    help="(--serve) autoscaler p99 target")
+    sf.add_argument("--slo_depth", type=int, default=32,
+                    help="(--serve) autoscaler queue-depth target")
+    sf.add_argument("--breach_windows", type=int, default=3,
+                    help="(--serve) consecutive breach windows before "
+                         "grow")
+    sf.add_argument("--idle_windows", type=int, default=10,
+                    help="(--serve) consecutive idle windows before "
+                         "shrink")
+    sf.add_argument("--max_replicas", type=int, default=8,
+                    help="(--serve) autoscaler growth ceiling")
+    sf.add_argument("--canary_w", type=int, default=0,
+                    help="(--serve) window at which one replica "
+                         "hot-reloads to a faulty sha (0 = never)")
+    sf.add_argument("--canary_pct", type=float, default=20.0,
+                    help="(--serve) canary traffic percentage")
+    sf.add_argument("--canary_err", type=float, default=1.0,
+                    help="(--serve) canary per-request fault "
+                         "probability")
+    sf.add_argument("--canary_min_requests", type=int, default=10,
+                    help="(--serve) canary verdict sample floor")
+    sf.add_argument("--die_w", type=int, default=None,
+                    help="(--serve) window at which the lowest live "
+                         "replica dies (deterministic kill)")
+    sf.add_argument("--rejoin_w", type=int, default=None,
+                    help="(--serve) window at which a dead replica "
+                         "rejoins")
     sf.set_defaults(fn=cmd_simfleet)
 
     sv = sub.add_parser(
@@ -1808,8 +1980,83 @@ def main(argv=None):
     sv.add_argument("--no_warmup", action="store_true",
                     help="skip tracing every bucket before traffic")
     sv.add_argument("--metrics", help="JSONL metrics output path")
+    sv.add_argument("--fleet_dir",
+                    help="fleet rendezvous directory: lease this "
+                         "replica into the serving fleet "
+                         "(serve/fleet.py) for `sparknet route`")
+    sv.add_argument("--replica", type=int, default=0,
+                    help="this replica's id in the fleet (also tags "
+                         "the chaos injectors)")
+    sv.add_argument("--replicas", type=int, default=0,
+                    help="initial fleet size hint (a higher --replica "
+                         "grows the world, the PR 12 admission path)")
+    sv.add_argument("--lease", type=float, default=3.0,
+                    help="fleet lease_s: the router evicts this "
+                         "replica when its beat goes stale past this")
+    sv.add_argument("--heartbeat_interval", type=float, default=0.5,
+                    help="fleet beat cadence (also bounds how stale "
+                         "the router's queue-depth view can be)")
+    sv.add_argument("--chaos",
+                    help="chaos spec, e.g. 'kill_replica=0,kill_req=20'"
+                         " (SIGKILL self after the 20th request) or "
+                         "'slow_replica=0,slow_ms=50' "
+                         "(resilience/chaos.py)")
     _add_perf_flags(sv, scan=True)
     sv.set_defaults(fn=cmd_serve)
+
+    rt = sub.add_parser(
+        "route",
+        help="serving-fleet router: discovers `sparknet serve "
+             "--fleet_dir` replicas through their leases, spreads "
+             "POST /predict by least queue depth with retry-once "
+             "failover, makes SLO autoscaling decisions, auto-rolls-"
+             "back a bad canary checkpoint")
+    rt.add_argument("--fleet_dir", required=True,
+                    help="the fleet rendezvous directory replicas "
+                         "lease into")
+    rt.add_argument("--host", default="127.0.0.1")
+    rt.add_argument("--port", type=int, default=0,
+                    help="0 = pick a free port (announced on stdout)")
+    rt.add_argument("--replicas", type=int, default=1,
+                    help="expected initial fleet size (late replicas "
+                         "grow the world on admission)")
+    rt.add_argument("--lease", type=float, default=3.0,
+                    help="lease_s: a replica whose beat is staler "
+                         "than this is evicted (failover window)")
+    rt.add_argument("--window_s", type=float, default=1.0,
+                    help="membership/SLO evaluation cadence")
+    rt.add_argument("--request_timeout", type=float, default=30.0,
+                    help="per-dispatch timeout toward a replica")
+    rt.add_argument("--no_autoscale", action="store_true",
+                    help="disable SLO autoscaling decisions")
+    rt.add_argument("--slo_p99_ms", type=float, default=500.0,
+                    help="autoscaler p99 target")
+    rt.add_argument("--slo_depth", type=int, default=32,
+                    help="autoscaler queue-depth target")
+    rt.add_argument("--breach_windows", type=int, default=3,
+                    help="consecutive breach windows before a grow "
+                         "decision (scale events; an orchestrator "
+                         "launches the replica)")
+    rt.add_argument("--idle_windows", type=int, default=30,
+                    help="consecutive idle windows before a shrink "
+                         "(drain order to the highest replica)")
+    rt.add_argument("--min_replicas", type=int, default=1)
+    rt.add_argument("--max_replicas", type=int, default=8)
+    rt.add_argument("--canary_pct", type=float, default=20.0,
+                    help="traffic share for a second checkpoint sha "
+                         "while a canary is in flight")
+    rt.add_argument("--canary_min_requests", type=int, default=20,
+                    help="canary responses required before a verdict")
+    rt.add_argument("--canary_err_delta", type=float, default=0.05,
+                    help="rollback when canary error rate exceeds "
+                         "baseline by this")
+    rt.add_argument("--canary_p99_delta_ms", type=float, default=500.0,
+                    help="rollback when canary p99 exceeds baseline "
+                         "by this")
+    rt.add_argument("--metrics", help="JSONL metrics output path "
+                                      "(route/scale/canary + "
+                                      "membership events)")
+    rt.set_defaults(fn=cmd_route)
 
     sb = sub.add_parser(
         "serve-bench",

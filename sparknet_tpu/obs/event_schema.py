@@ -23,6 +23,10 @@ EVENTS = {
         "fields": [],
         "open": True,
     },
+    'canary': {
+        "fields": ['action', 'base_err_rate', 'base_p99_ms', 'baseline_sha', 'err_rate', 'p99_ms', 'pct', 'reason', 'requests', 'sha'],
+        "open": False,
+    },
     'chaos': {
         "fields": ['kind'],
         "open": True,
@@ -141,6 +145,14 @@ EVENTS = {
     },
     'round': {
         "fields": ['images_per_s', 'iter', 'loss', 'lr', 'round'],
+        "open": False,
+    },
+    'route': {
+        "fields": ['attempts', 'code', 'latency_ms', 'replica', 'retried', 'sha'],
+        "open": False,
+    },
+    'scale': {
+        "fields": ['action', 'breach_windows', 'live', 'p99_ms', 'queue_depth', 'reason', 'target'],
         "open": False,
     },
     'serve_batch': {

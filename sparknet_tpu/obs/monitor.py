@@ -104,6 +104,14 @@ class MonitorState:
         self.last_serve_reject = None
         self.last_serve_reload = None
         self.serve_summary = None
+        # routing fleet (serve/fleet.py, `sparknet route`)
+        self.route_dispatches = 0
+        self.route_by_code = collections.Counter()
+        self.route_retried = 0
+        self.route_lat_ms = collections.deque(maxlen=2048)
+        self.scale_events = []      # (action, reason, live)
+        self.last_canary = None
+        self.canary_rollbacks = 0
         self.done = None            # summary event, if the run finished
 
     def update(self, ev):               # spk: thread-entry
@@ -250,6 +258,21 @@ class MonitorState:
             self.last_serve_reload = ev
         elif kind == "serve_summary":
             self.serve_summary = ev
+        elif kind == "route":
+            self.route_dispatches += 1
+            if _num(ev.get("code")):
+                self.route_by_code[int(ev["code"])] += 1
+            if ev.get("retried"):
+                self.route_retried += 1
+            if _num(ev.get("latency_ms")):
+                self.route_lat_ms.append(ev["latency_ms"])
+        elif kind == "scale":
+            self.scale_events.append((ev.get("action"),
+                                      ev.get("reason"), ev.get("live")))
+        elif kind == "canary":
+            self.last_canary = ev
+            if ev.get("action") == "rollback":
+                self.canary_rollbacks += 1
         elif kind == "summary":
             self.done = ev
 
@@ -440,6 +463,36 @@ class MonitorState:
             if self.serve_summary is not None and \
                     self.serve_summary.get("drained"):
                 L.append("    drained cleanly")
+        if self.route_dispatches or self.scale_events or self.last_canary:
+            from .stepstats import percentiles
+            ok = self.route_by_code.get(200, 0)
+            bits = [f"dispatches {self.route_dispatches}"]
+            if self.route_dispatches:
+                bits.append(f"avail {ok / self.route_dispatches:.1%}")
+            if self.route_retried:
+                bits.append(f"retried {self.route_retried}")
+            bad = {c: n for c, n in sorted(self.route_by_code.items())
+                   if c != 200}
+            if bad:
+                bits.append("codes " + " ".join(
+                    f"{c}:{n}" for c, n in bad.items()))
+            if self.route_lat_ms:
+                p = percentiles(list(self.route_lat_ms))
+                bits.append(f"p99 {p['p99']:.1f}ms")
+            L.append("  routing: " + "  ".join(bits))
+            if self.scale_events:
+                a, reason, live = self.scale_events[-1]
+                L.append(f"    scale: {len(self.scale_events)} "
+                         f"decision(s); last {a} ({reason}) "
+                         f"at live {live}")
+            if self.last_canary is not None:
+                c = self.last_canary
+                line = f"    canary: {c.get('action')} " \
+                       f"sha={c.get('sha')} " \
+                       f"(baseline {c.get('baseline_sha')})"
+                if self.canary_rollbacks:
+                    line += f"  rollbacks {self.canary_rollbacks}"
+                L.append(line)
         if self.straggler_counts:
             worst = self.straggler_counts.most_common(1)[0]
             L.append(f"  stragglers: worker {worst[0]} flagged "

@@ -503,6 +503,41 @@ def aggregate(events):
                 if last.get(k) is not None:
                     sv[k] = last[k]
         rep["serving"] = sv
+
+    # -- routing fleet (serve/fleet.py: `sparknet route`) ------------------
+    rt = [e for e in events if e.get("event") == "route"]
+    sc = [e for e in events if e.get("event") == "scale"]
+    cn = [e for e in events if e.get("event") == "canary"]
+    if rt or sc or cn:
+        fl = {"dispatches": len(rt)}
+        if rt:
+            codes = collections.Counter(
+                int(e["code"]) for e in rt if _num(e.get("code")))
+            fl["by_code"] = {str(k): v for k, v in sorted(codes.items())}
+            fl["availability"] = round(codes.get(200, 0) / len(rt), 4)
+            fl["retried"] = sum(1 for e in rt if e.get("retried"))
+            lats = [e["latency_ms"] for e in rt
+                    if _num(e.get("latency_ms"))]
+            if lats:
+                fl.update({f"latency_ms_{k}": round(v, 3)
+                           for k, v in percentiles(lats).items()})
+            fl["by_replica"] = dict(collections.Counter(
+                str(e.get("replica")) for e in rt
+                if e.get("replica") is not None))
+        if sc:
+            fl["scale_events"] = [
+                {k: e.get(k) for k in ("action", "reason", "live",
+                                       "p99_ms", "queue_depth")}
+                for e in sc]
+        if cn:
+            fl["canary_events"] = [
+                {k: e.get(k) for k in ("action", "sha", "baseline_sha",
+                                       "reason", "err_rate",
+                                       "base_err_rate", "requests")}
+                for e in cn]
+            fl["canary_rollbacks"] = sum(
+                1 for e in cn if e.get("action") == "rollback")
+        rep["routing"] = fl
     return rep
 
 
@@ -946,6 +981,37 @@ def render(rep):
             L.append(f"  hot reloads to iters {sv['reload_iters']}")
         if sv.get("drained"):
             L.append("  drained cleanly")
+    fl = rep.get("routing")
+    if fl:
+        hdr("routing fleet")
+        line = f"  dispatches: {fl.get('dispatches', 0)}"
+        if fl.get("by_code"):
+            line += " (" + ", ".join(
+                f"{k}: {v}" for k, v in sorted(fl["by_code"].items())) \
+                + ")"
+        L.append(line)
+        if _num(fl.get("availability")):
+            line = f"  availability {fl['availability']:.2%}, " \
+                   f"retried {fl.get('retried', 0)}"
+            if _num(fl.get("latency_ms_p99")):
+                line += f", latency p99 {fl['latency_ms_p99']:.3f} ms"
+            L.append(line)
+        if fl.get("by_replica"):
+            L.append("  by replica: " + ", ".join(
+                f"{k}: {v}" for k, v in sorted(fl["by_replica"].items())))
+        for e in fl.get("scale_events", []):
+            L.append(f"  scale {e.get('action')} ({e.get('reason')}): "
+                     f"live {e.get('live')}, p99 {e.get('p99_ms')} ms, "
+                     f"depth {e.get('queue_depth')}")
+        for e in fl.get("canary_events", []):
+            bits = [f"  canary {e.get('action')} sha={e.get('sha')} "
+                    f"(baseline {e.get('baseline_sha')})"]
+            if e.get("reason"):
+                bits.append(f"reason={e['reason']}")
+            if _num(e.get("err_rate")):
+                bits.append(f"err {e['err_rate']:.2%} vs "
+                            f"{(e.get('base_err_rate') or 0):.2%}")
+            L.append(" ".join(bits))
     L.append("")
     return "\n".join(L)
 

@@ -89,6 +89,19 @@ subsystem claims to survive — on a schedule tests can replay exactly:
                    and a failing domain takes all its hosts down
                    together — the correlated-outage shape quorum
                    settings must survive. K<=1 means independent hosts.
+  kill_replica=R, kill_req=N   serve replica R dies after serving its
+                   N-th request (N defaults to 0 — die on first). In a
+                   real fleet the targeted `sparknet serve --replica R`
+                   process SIGKILLs ITSELF mid-load — the router sees
+                   in-flight dispatches fail and the lease lapse, the
+                   true crash shape; in `sparknet simfleet --serve` the
+                   virtual replica goes silent. Exercises router
+                   retry-once + ElasticPolicy replica eviction.
+  slow_replica=R, slow_ms=S   serve replica R pays S extra
+                   milliseconds per request (persistent) — the serving
+                   twin of slow_host: drives its queue depth up so the
+                   router's least-depth spread and the SLO autoscaler
+                   have a measurable straggler to route around.
 
 Armed via `--chaos "nan_step=30,io_p=0.02,seed=1"` or the SPARKNET_CHAOS
 env var (same spec), which data sources and solvers pick up through
@@ -144,6 +157,8 @@ class ChaosMonkey:
                  slow_worker=None, slow_s=0.0, slow_round=0,
                  slow_h2d=0.0,
                  fail_rate=0.0, fail_seed=0, fail_corr=0,
+                 kill_replica=None, kill_req=0,
+                 slow_replica=None, slow_ms=0.0,
                  seed=0, metrics=None, log_fn=print):
         self.nan_step = None if nan_step is None else int(nan_step)
         self.nan_repeat = bool(nan_repeat)
@@ -207,6 +222,15 @@ class ChaosMonkey:
         self.fail_seed = int(fail_seed)
         self.fail_corr = max(0, int(fail_corr))
         self._fail_dead = set()   # hosts fail_rate already took down
+        # serving-tier injectors (serve/fleet.py, sim/servefleet.py)
+        self.kill_replica = None if kill_replica is None \
+            else int(kill_replica)
+        self.kill_req = int(kill_req)
+        self._replica_kill_fired = False
+        self.slow_replica = None if slow_replica is None \
+            else int(slow_replica)
+        self.slow_ms = float(slow_ms)
+        self._slow_replica_logged = False
         self._rng = np.random.RandomState(seed)
         self.metrics = metrics
         self.log = log_fn or (lambda *a: None)
@@ -238,6 +262,8 @@ class ChaosMonkey:
                  "slow_worker": int, "slow_s": float, "slow_round": int,
                  "slow_h2d": float,
                  "fail_rate": float, "fail_seed": int, "fail_corr": int,
+                 "kill_replica": int, "kill_req": int,
+                 "slow_replica": int, "slow_ms": float,
                  "seed": int}
         valid = f"valid injectors: {', '.join(sorted(known))}"
         fields = {}
@@ -547,3 +573,58 @@ class ChaosMonkey:
                         nbytes=int(nbytes))
         time.sleep(self.slow_h2d)
         return self.slow_h2d
+
+    # -- serving-tier injectors (replica fleets) ----------------------------
+    def replica_kill_due(self, replica, served):
+        """True once replica ``replica`` has served ``served`` >=
+        kill_req requests — the non-firing query both renderings share
+        (the simulator silences the virtual replica; the real process
+        calls maybe_kill_replica_self). One-shot."""
+        if self.kill_replica is None or replica != self.kill_replica \
+                or self._replica_kill_fired or served < self.kill_req:
+            return False
+        self._replica_kill_fired = True
+        self._event("kill_replica", replica=replica, served=int(served))
+        return True
+
+    def maybe_kill_replica_self(self, replica, served, on_kill=None):
+        """The REAL fleet rendering of kill_replica: the targeted
+        `sparknet serve --replica R` process dies by SIGKILL after its
+        kill_req-th request — in-flight dispatches fail at the router
+        and the lease lapses, exactly what an OOM kill mid-load looks
+        like. ``on_kill`` runs first (stop heartbeating so the last
+        lease predates the corpse)."""
+        if not self.replica_kill_due(replica, served):
+            return False
+        if on_kill is not None:
+            try:
+                on_kill()
+            except Exception:
+                pass
+        os.kill(os.getpid(), signal.SIGKILL)
+        return True                           # not reached
+
+    def replica_slow_spec(self, replica):
+        """(replica, extra_seconds_per_request) when the slow_replica
+        injector targets ``replica``, else None. Non-blocking — the
+        simulator adds the seconds to virtual service time; the real
+        serve loop sleeps them (maybe_slow_replica). Logs one chaos
+        event on first activation."""
+        if self.slow_replica is None or replica != self.slow_replica \
+                or self.slow_ms <= 0:
+            return None
+        if not self._slow_replica_logged:
+            self._slow_replica_logged = True
+            self._event("slow_replica", replica=replica,
+                        ms=self.slow_ms)
+        return (self.slow_replica, self.slow_ms / 1e3)
+
+    def maybe_slow_replica(self, replica):
+        """The REAL rendering of slow_replica: the serve loop sleeps
+        slow_ms before answering each request (persistent straggler).
+        Returns the injected seconds."""
+        spec = self.replica_slow_spec(replica)
+        if spec is None:
+            return 0.0
+        time.sleep(spec[1])
+        return spec[1]

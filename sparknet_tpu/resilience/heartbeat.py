@@ -164,7 +164,7 @@ class HeartbeatCoordinator:
 
     def __init__(self, directory, host=None, n_hosts=None, interval_s=0.5,
                  lease_s=3.0, metrics=None, log_fn=print, chaos=None,
-                 clock=None, dirops=None):
+                 clock=None, dirops=None, payload_fn=None):
         if host is None or n_hosts is None:
             raise ValueError("heartbeat needs host= (this process's id) "
                              "and n_hosts= (the world size)")
@@ -185,6 +185,13 @@ class HeartbeatCoordinator:
         self.metrics = metrics
         self.log = log_fn or (lambda *a: None)
         self.chaos = chaos
+        # optional beat payload: a callable returning extra JSON-safe
+        # fields merged into every lease record (a serve replica's
+        # queue depth / in-flight / checkpoint sha / drain state —
+        # serve/fleet.py). Core protocol keys always win on collision,
+        # and readers use .get(), so beats from payload-free builds
+        # stay interchangeable with enriched ones.
+        self.payload_fn = payload_fn
         self._lock = threading.Lock()
         self._seq = 0                                # spk: guarded-by=_lock
         self._round = -1                             # spk: guarded-by=_lock
@@ -237,14 +244,26 @@ class HeartbeatCoordinator:
         monotonic receipt time (a ``trace_align`` event), which is what
         obs/fleettrace.py solves per-host clock offsets from. Readers
         use .get(): beats from older builds without ``mono`` stay
-        readable, they just contribute no beacon."""
+        readable, they just contribute no beacon.
+
+        ``payload_fn`` extras are gathered OUTSIDE the lock (the
+        callable typically reads other locked state — a batcher's
+        queue depth — and calling into foreign locks under ``_lock``
+        would invert lock order); core protocol keys always win."""
+        extra = None
+        if self.payload_fn is not None:
+            try:
+                extra = self.payload_fn()
+            except Exception as e:   # a payload bug must not stop leasing
+                self.log(f"heartbeat: payload_fn error: {e!r}")
         with self._lock:
             if self._stopped:
                 return
             self._seq += 1
-            rec = {"host": self.host, "seq": self._seq,
-                   "round": self._round, "stamp": self.clock.time(),
-                   "mono": self.clock.monotonic()}
+            rec = dict(extra) if extra else {}
+            rec.update({"host": self.host, "seq": self._seq,
+                        "round": self._round, "stamp": self.clock.time(),
+                        "mono": self.clock.monotonic()})
         self.dirops.write_json(self._hb_name(self.host), rec)
 
     def announce_round(self, round_idx):

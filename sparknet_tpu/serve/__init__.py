@@ -8,12 +8,19 @@ batcher.py  thread-safe request queue: continuous batching, pad-to-
 server.py   stdlib HTTP front end (/predict /healthz /metrics) with
             graceful SIGTERM drain and the supervisor exit contract
 loadgen.py  closed- and open-loop load generator (`sparknet serve-bench`)
+fleet.py    `sparknet route` — lease-based replica membership over the
+            heartbeat rendezvous, least-depth routing with retry-once
+            failover, SLO autoscaling, canary rollout with rollback
 """
 
 from .engine import ServeEngine, bucket_sizes, bucket_for
 from .batcher import Batcher, RejectedError
 from .server import ServeStats, serve_http
 from .loadgen import run_loadgen
+from .fleet import (ReplicaMember, Router, SLOAutoscaler,
+                    CanaryController, route_http)
 
 __all__ = ["ServeEngine", "bucket_sizes", "bucket_for", "Batcher",
-           "RejectedError", "ServeStats", "serve_http", "run_loadgen"]
+           "RejectedError", "ServeStats", "serve_http", "run_loadgen",
+           "ReplicaMember", "Router", "SLOAutoscaler",
+           "CanaryController", "route_http"]

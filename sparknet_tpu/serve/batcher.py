@@ -79,7 +79,10 @@ class Batcher:
         reject = None
         with self._cv:
             if self._closed:
-                reject = ("draining", self._rows)
+                # distinct from queue_full so a router (serve/fleet.py)
+                # and `sparknet report` can tell planned drain from
+                # overload backpressure
+                reject = ("replica_draining", self._rows)
             elif self._rows + req.n > self.queue_limit:
                 reject = ("queue_full", self._rows)
             else:
@@ -146,6 +149,13 @@ class Batcher:
         with self._cv:
             self._closed = True
             self._cv.notify_all()
+
+    def draining(self):                   # spk: thread-entry
+        """True once close() ran — surfaced on /healthz and in the
+        replica's lease payload so the router stops picking this
+        replica within one beat."""
+        with self._cv:
+            return self._closed
 
     def pending(self):
         """Requests still queued (the drain loop runs until zero)."""

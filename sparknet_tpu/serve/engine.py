@@ -291,6 +291,7 @@ class ServeEngine:
         return {
             "iter": None if loaded is None else loaded.get("iter"),
             "model": None if loaded is None else loaded.get("model"),
+            "sha": None if loaded is None else loaded.get("sha256"),
             "reloads": reloads,
             "buckets": list(self.buckets),
             "feeds": {n: list(s) for n, s in self.feed_shapes().items()},

@@ -86,7 +86,7 @@ class ServeStats:
         return out
 
 
-def _make_handler(engine, batcher, stats, timeout_s):
+def _make_handler(engine, batcher, stats, timeout_s, member=None):
     from http.server import BaseHTTPRequestHandler
 
     class Handler(BaseHTTPRequestHandler):
@@ -108,6 +108,11 @@ def _make_handler(engine, batcher, stats, timeout_s):
                 st = engine.status()
                 st["status"] = "ok"
                 st["queue_depth"] = batcher.depth()
+                st["draining"] = batcher.draining()
+                if member is not None:
+                    # lease/membership fields (serve/fleet.py): the
+                    # same truth the router reads from the beat
+                    st.update(member.health())
                 self._send_json(200, st)
             elif self.path == "/metrics":
                 snap = stats.snapshot()
@@ -233,12 +238,14 @@ def _run_batch(engine, batcher, stats, metrics, reqs, wait_ms):
 
 def serve_loop(engine, batcher, stats, metrics=None, policy=None,
                reload_poll_s=0.0, stop_event=None, idle_timeout=0.05,
-               log_fn=print):
+               chaos=None, replica=None, log_fn=print):
     """The single consumer thread: batches, signals, hot reload, drain.
     Returns 0 after a clean drain (the supervisor contract)."""
     log = log_fn or (lambda *a: None)
     next_reload = time.monotonic() + reload_poll_s if reload_poll_s else None
+    inject = chaos is not None and replica is not None
     draining = False
+    served = 0
     while True:
         if not draining:
             action = policy.pending() if policy is not None else None
@@ -248,6 +255,8 @@ def serve_loop(engine, batcher, stats, metrics=None, policy=None,
                 batcher.close()
                 draining = True
             elif stop_event is not None and stop_event.is_set():
+                log("serve: drain requested; draining "
+                    f"{batcher.pending()} queued request(s)")
                 batcher.close()
                 draining = True
         if next_reload is not None and not draining \
@@ -257,22 +266,39 @@ def serve_loop(engine, batcher, stats, metrics=None, policy=None,
             next_reload = time.monotonic() + reload_poll_s
         reqs, wait_ms = batcher.next_batch(timeout=idle_timeout)
         if reqs:
+            if inject:
+                chaos.maybe_slow_replica(int(replica))
             _run_batch(engine, batcher, stats, metrics, reqs, wait_ms)
+            served += len(reqs)
+            if inject:
+                # kill_replica fires AFTER the kill_req-th request is
+                # fulfilled: the dispatch-then-die case the router's
+                # retry-once must never double
+                chaos.maybe_kill_replica_self(int(replica), served)
         elif draining and batcher.pending() == 0:
             return 0
 
 
 def serve_http(engine, batcher, host="127.0.0.1", port=0, metrics=None,
                policy=None, reload_poll_s=0.0, stop_event=None,
-               request_timeout_s=30.0, log_fn=print):
-    """Bind, announce, serve until drained; returns the exit code."""
+               request_timeout_s=30.0, member=None, chaos=None,
+               replica=None, log_fn=print):
+    """Bind, announce, serve until drained; returns the exit code.
+    With ``member`` (serve/fleet.py ReplicaMember) the replica leases
+    into the fleet rendezvous once the socket is bound (the URL is in
+    the beat payload) and its drain order rides ``stop_event``."""
     from http.server import ThreadingHTTPServer
     log = log_fn or (lambda *a: None)
     stats = ServeStats()
-    handler = _make_handler(engine, batcher, stats, request_timeout_s)
+    handler = _make_handler(engine, batcher, stats, request_timeout_s,
+                            member=member)
     httpd = ThreadingHTTPServer((host, int(port)), handler)
     httpd.daemon_threads = True
     addr = f"http://{httpd.server_address[0]}:{httpd.server_address[1]}"
+    if member is not None:
+        member.start(url=addr)
+        if stop_event is None:
+            stop_event = member.drain_event
     st = engine.status()
     log(f"sparknet serve: listening on {addr} (iter {st.get('iter')}, "
         f"buckets {st.get('buckets')})")
@@ -283,10 +309,13 @@ def serve_http(engine, batcher, host="127.0.0.1", port=0, metrics=None,
     try:
         rc = serve_loop(engine, batcher, stats, metrics=metrics,
                         policy=policy, reload_poll_s=reload_poll_s,
-                        stop_event=stop_event, log_fn=log)
+                        stop_event=stop_event, chaos=chaos,
+                        replica=replica, log_fn=log)
     finally:
         httpd.shutdown()
         httpd.server_close()
+        if member is not None:
+            member.stop()
     snap = stats.snapshot()
     if metrics is not None:
         metrics.log("serve_summary", requests=snap.get("requests"),

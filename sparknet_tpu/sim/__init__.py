@@ -28,6 +28,11 @@ mocked) against the injectable Clock/Dir seam (resilience/seam.py):
   sweep            grids over fleet size × failure rate × τ × s ×
                    lease/quorum — the study behind DEPLOY.md's tuning
                    tables
+  servefleet       the SERVING fleet under open-loop arrival traces:
+                   the real Router/SLOAutoscaler/CanaryController over
+                   virtual replicas, proving no-lost-request-without-
+                   429 under kill/churn/flash-crowd (`sparknet
+                   simfleet --serve`)
 
 Everything is deterministic given the seed: same spec, same timeline.
 """
@@ -35,6 +40,8 @@ Everything is deterministic given the seed: same spec, same timeline.
 from .clock import SimClock
 from .memdir import MemDir
 from .fleet import FleetSim
+from .servefleet import ServeFleetSim
 from . import replay, sweep
 
-__all__ = ["SimClock", "MemDir", "FleetSim", "replay", "sweep"]
+__all__ = ["SimClock", "MemDir", "FleetSim", "ServeFleetSim", "replay",
+           "sweep"]

@@ -287,9 +287,10 @@ class TestBatcher:
         b = Batcher(max_batch=4, queue_limit=8)
         b.submit({"x": [0]}, n=1)
         b.close()
+        assert b.draining()
         with pytest.raises(RejectedError) as ei:
             b.submit({"x": [0]}, n=1)
-        assert ei.value.reason == "draining"
+        assert ei.value.reason == "replica_draining"
         # queued work is still drainable after close
         reqs, _ = b.next_batch(timeout=0.2)
         assert len(reqs) == 1

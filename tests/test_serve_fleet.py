@@ -1,0 +1,519 @@
+"""Fleet serving (serve/fleet.py + sim/servefleet.py): leased replica
+membership payloads, router failover semantics (503-not-hang when all
+replicas drain, retry-once that never doubles a fulfilled request,
+eviction/rejoin within one lease window), SLO autoscaler hysteresis,
+canary split + auto-rollback, the replica chaos grammar, and the
+ServeFleetSim no-lost-request-without-429 invariants."""
+
+import json
+import threading
+
+import pytest
+
+from conftest import REFERENCE  # noqa: F401  (conftest sets the cpu env)
+
+from sparknet_tpu.resilience.chaos import ChaosMonkey
+from sparknet_tpu.serve.fleet import (CanaryController, ReplicaMember,
+                                      Router, SLOAutoscaler)
+from sparknet_tpu.sim import MemDir, ServeFleetSim, SimClock
+from sparknet_tpu.sim import sweep as sim_sweep
+
+
+class _Sink:
+    def __init__(self):
+        self.events = []
+
+    def log(self, event, **fields):
+        self.events.append(dict(fields, event=event))
+
+    def of(self, kind):
+        return [e for e in self.events if e["event"] == kind]
+
+
+def _quiet(*a, **k):
+    pass
+
+
+class _FakeBatcher:
+    def __init__(self, depth=0, pending=0, draining=False):
+        self._depth, self._pending = depth, pending
+        self._draining = draining
+
+    def depth(self):
+        return self._depth
+
+    def pending(self):
+        return self._pending
+
+    def draining(self):
+        return self._draining
+
+
+class _FakeEngine:
+    def __init__(self, sha="sha-a", it=7):
+        self._sha, self._it = sha, it
+
+    def status(self):
+        return {"sha": self._sha, "iter": self._it}
+
+
+def _member(clock, dirops, replica, n, interval=0.2, lease=1.0, **kw):
+    kw.setdefault("engine", _FakeEngine())
+    kw.setdefault("batcher", _FakeBatcher())
+    kw.setdefault("url", f"sim://replica/{replica}")
+    return ReplicaMember(dirops.root, replica, replicas=n,
+                         interval_s=interval, lease_s=lease,
+                         log_fn=_quiet, clock=clock, dirops=dirops, **kw)
+
+
+def _router(clock, dirops, n, lease=1.0, post_fn=None, **kw):
+    kw.setdefault("log_fn", _quiet)
+    return Router(dirops.root, replicas=n, lease_s=lease, clock=clock,
+                  dirops=dirops, post_fn=post_fn, **kw)
+
+
+# ----------------------------------------------------- ReplicaMember ----
+class TestReplicaMember:
+    def test_beat_payload_carries_the_serving_truth(self):
+        clock = SimClock()
+        d = MemDir(clock)
+        m = _member(clock, d, 0, 2,
+                    batcher=_FakeBatcher(depth=5, pending=2))
+        m.coord.beat()
+        rec = d.read_json("hb-0.json")
+        assert rec["url"] == "sim://replica/0"
+        assert rec["queue_depth"] == 5 and rec["in_flight"] == 2
+        assert rec["sha"] == "sha-a" and rec["iter"] == 7
+        assert rec["draining"] is False
+        # protocol core keys always win and are present
+        assert rec["host"] == 0 and "seq" in rec and "stamp" in rec
+
+    def test_drain_order_file_fires_drain_event(self):
+        clock = SimClock()
+        d = MemDir(clock)
+        m = _member(clock, d, 1, 2)
+        m.coord.beat()
+        assert not m.drain_event.is_set()
+        d.write_json("drain-1.json", {"replica": 1, "stamp": clock.time()})
+        m.coord.beat()
+        assert m.drain_event.is_set()
+        assert d.read_json("hb-1.json")["draining"] is True
+
+    def test_start_removes_stale_drain_order(self):
+        clock = SimClock()
+        d = MemDir(clock)
+        d.write_json("drain-0.json", {"replica": 0, "stamp": 0.0})
+        m = _member(clock, d, 0, 1)
+        m.start()
+        try:
+            assert not d.exists("drain-0.json")
+            assert not m.drain_event.is_set()
+        finally:
+            m.stop()
+
+    def test_health_reports_lease_and_drain_fields(self):
+        clock = SimClock()
+        d = MemDir(clock)
+        m = _member(clock, d, 0, 3, lease=2.0)
+        m.coord.beat()
+        clock.sleep(0.5)
+        h = m.health()
+        assert h["replica"] == 0 and h["world"] == 3
+        assert h["lease_s"] == 2.0
+        assert h["lease_age_s"] == pytest.approx(0.5, abs=0.05)
+        assert h["draining"] is False
+        m.drain_event.set()
+        assert m.health()["draining"] is True
+
+
+# ------------------------------------------------------------ Router ----
+class TestRouterMembership:
+    def test_dead_replica_evicted_within_one_lease_window(self):
+        clock = SimClock()
+        d = MemDir(clock)
+        ms = [_member(clock, d, r, 2) for r in range(2)]
+        for m in ms:
+            m.coord.beat()
+        rt = _router(clock, d, 2, lease=1.0)
+        assert sorted(rt.poll()) == [0, 1]
+        # replica 1 stops beating; 0 keeps leasing
+        for _ in range(8):
+            clock.sleep(0.2)
+            ms[0].coord.beat()
+            rt.poll()
+        assert rt.poll() == [0]
+        ev = rt.policy.evictions
+        assert len(ev) == 1 and ev[0]["worker"] == 1
+        assert ev[0]["reason"] == "lease_expired"
+
+    def test_rejoin_after_eviction_readmitted_and_picked(self):
+        clock = SimClock()
+        d = MemDir(clock)
+        ms = [_member(clock, d, r, 2) for r in range(2)]
+        for m in ms:
+            m.coord.beat()
+        rt = _router(clock, d, 2, lease=1.0)
+        rt.poll()
+        for _ in range(8):           # replica 1 dies -> evicted
+            clock.sleep(0.2)
+            ms[0].coord.beat()
+            rt.poll()
+        assert rt.poll() == [0]
+        ms[1].coord.beat()           # rejoins: one beat suffices
+        clock.sleep(0.2)
+        ms[0].coord.beat()
+        assert sorted(rt.poll()) == [0, 1]
+        assert len(rt.policy.readmissions) == 1
+        # ...and it receives traffic again: picks must include 1
+        picked = {rt.pick()[0] for _ in range(8)}
+        assert 1 in picked
+
+    def test_late_replica_above_world_admitted_via_grow(self):
+        clock = SimClock()
+        d = MemDir(clock)
+        m0 = _member(clock, d, 0, 1)
+        m0.coord.beat()
+        rt = _router(clock, d, 1, lease=1.0)
+        assert rt.poll() == [0]
+        m1 = _member(clock, d, 1, 2)     # next id leases in beyond world
+        m1.coord.beat()
+        assert sorted(rt.poll()) == [0, 1]
+        assert rt.policy.n == 2
+        assert any(a["worker"] == 1 and a.get("via") == "grow"
+                   for a in rt.policy.admissions)
+
+    def test_quorum_lost_keeps_serving_503_then_recovers(self):
+        clock = SimClock()
+        d = MemDir(clock)
+        m = _member(clock, d, 0, 1)
+        m.coord.beat()
+        rt = _router(clock, d, 1, lease=1.0,
+                     post_fn=lambda u, b, t: (200, b"{}"))
+        rt.poll()
+        clock.sleep(2.5)             # lease lapses past the grace window
+        rt.poll()
+        clock.sleep(0.2)
+        rt.poll()
+        assert rt.quorum_lost
+        code, data = rt.dispatch(b"{}")
+        assert code == 503
+        assert json.loads(data)["reason"] == "all_draining_or_dead"
+        m.coord.beat()               # capacity leases back in
+        rt.poll()
+        assert not rt.quorum_lost
+        assert rt.dispatch(b"{}")[0] == 200
+
+
+class TestRouterDispatch:
+    def _fleet(self, n, post_fn, lease=1.0, **kw):
+        clock = SimClock()
+        d = MemDir(clock)
+        ms = [_member(clock, d, r, n) for r in range(n)]
+        for m in ms:
+            m.coord.beat()
+        rt = _router(clock, d, n, lease=lease, post_fn=post_fn, **kw)
+        rt.poll()
+        return clock, d, ms, rt
+
+    def test_all_replicas_draining_returns_503_not_a_hang(self):
+        calls = []
+
+        def post(url, body, t):
+            calls.append(url)
+            return 200, b"{}"
+
+        clock = SimClock()
+        d = MemDir(clock)
+        ms = [_member(clock, d, r, 2,
+                      batcher=_FakeBatcher(draining=True))
+              for r in range(2)]
+        for m in ms:
+            m.coord.beat()
+        rt = _router(clock, d, 2, post_fn=post)
+        rt.poll()
+        code, data = rt.dispatch(b"{}")
+        assert code == 503
+        assert json.loads(data)["reason"] == "all_draining_or_dead"
+        assert calls == []           # nothing was dispatched anywhere
+        assert rt.stats_snapshot()["no_replica"] == 1
+
+    def test_fulfilled_request_is_never_doubled(self):
+        # dispatch-then-die: the replica answers 200 and is then
+        # killed. The response was received -> exactly one dispatch,
+        # even though the replica is dead a heartbeat later.
+        calls = []
+
+        def post(url, body, t):
+            calls.append(url)
+            return 200, b'{"ok": true}'
+
+        clock, d, ms, rt = self._fleet(3, post)
+        code, _ = rt.dispatch(b"{}")
+        assert code == 200
+        assert len(calls) == 1
+
+    def test_error_response_is_final_no_retry(self):
+        calls = []
+
+        def post(url, body, t):
+            calls.append(url)
+            return 500, b'{"error": "model"}'
+
+        clock, d, ms, rt = self._fleet(3, post)
+        code, _ = rt.dispatch(b"{}")
+        assert code == 500
+        assert len(calls) == 1       # a received response is final
+        assert rt.stats_snapshot()["retries"] == 0
+
+    def test_transport_failure_retries_once_on_a_different_replica(self):
+        calls = []
+
+        def post(url, body, t):
+            calls.append(url)
+            if len(calls) == 1:
+                return -1, b""       # no response received
+            return 200, b"{}"
+
+        clock, d, ms, rt = self._fleet(3, post)
+        code, _ = rt.dispatch(b"{}")
+        assert code == 200
+        assert len(calls) == 2 and calls[0] != calls[1]
+        assert rt.stats_snapshot()["retries"] == 1
+
+    def test_transport_failure_twice_maps_to_503_unreachable(self):
+        def post(url, body, t):
+            return -1, b""
+
+        clock, d, ms, rt = self._fleet(2, post)
+        code, data = rt.dispatch(b"{}")
+        assert code == 503
+        assert json.loads(data)["reason"] == "replica_unreachable"
+
+    def test_pick_prefers_least_advertised_depth(self):
+        clock = SimClock()
+        d = MemDir(clock)
+        for r, depth in ((0, 9), (1, 0), (2, 4)):
+            _member(clock, d, r, 3,
+                    batcher=_FakeBatcher(depth=depth)).coord.beat()
+        rt = _router(clock, d, 3)
+        rt.poll()
+        assert rt.pick()[0] == 1
+
+    def test_pick_spreads_equal_depth_round_robin(self):
+        clock = SimClock()
+        d = MemDir(clock)
+        for r in range(3):
+            _member(clock, d, r, 3).coord.beat()
+        rt = _router(clock, d, 3)
+        rt.poll()
+        # stale-depth herding guard: repeated picks within one beat
+        # window must not all land on one replica
+        assert len({rt.pick()[0] for _ in range(6)}) == 3
+
+
+# ----------------------------------------------------- SLOAutoscaler ----
+class TestSLOAutoscaler:
+    def _stats(self, w, p99=None, depth=0, reqs=1):
+        return {"window": w, "requests": reqs, "errors": 0,
+                "queue_depth": depth, "p99_ms": p99}
+
+    def test_grow_needs_k_consecutive_breach_windows(self):
+        sink = _Sink()
+        a = SLOAutoscaler(p99_ms=100.0, windows=3, metrics=sink,
+                          log_fn=_quiet)
+        assert a.observe(self._stats(1, p99=500.0), live=2) is None
+        assert a.observe(self._stats(2, p99=500.0), live=2) is None
+        assert a.observe(self._stats(3, p99=500.0), live=2) == "grow"
+        ev = sink.of("scale")
+        assert len(ev) == 1 and ev[0]["action"] == "grow"
+        assert ev[0]["reason"] == "p99_breach"
+        # re-armed: the streak must rebuild before the next decision
+        assert a.observe(self._stats(4, p99=500.0), live=3) is None
+
+    def test_one_healthy_window_resets_the_streak(self):
+        a = SLOAutoscaler(p99_ms=100.0, windows=3, log_fn=_quiet)
+        a.observe(self._stats(1, p99=500.0), live=2)
+        a.observe(self._stats(2, p99=50.0), live=2)    # heals
+        a.observe(self._stats(3, p99=500.0), live=2)
+        assert a.observe(self._stats(4, p99=500.0), live=2) is None
+
+    def test_depth_breach_grows_too(self):
+        a = SLOAutoscaler(p99_ms=1e9, depth=8, windows=2, log_fn=_quiet)
+        a.observe(self._stats(1, depth=20), live=1)
+        assert a.observe(self._stats(2, depth=20), live=1) == "grow"
+
+    def test_grow_capped_at_max_replicas(self):
+        a = SLOAutoscaler(p99_ms=100.0, windows=1, max_replicas=2,
+                          log_fn=_quiet)
+        assert a.observe(self._stats(1, p99=500.0), live=2) is None
+
+    def test_sustained_idle_shrinks_but_never_below_min(self):
+        a = SLOAutoscaler(idle_windows=3, min_replicas=1, log_fn=_quiet)
+        idle = self._stats(0, reqs=0, depth=0)
+        assert a.observe(dict(idle, window=1), live=2) is None
+        assert a.observe(dict(idle, window=2), live=2) is None
+        assert a.observe(dict(idle, window=3), live=2) == "shrink"
+        for w in (4, 5, 6):
+            assert a.observe(dict(idle, window=w), live=1) is None
+
+
+# -------------------------------------------------- CanaryController ----
+class TestCanaryController:
+    def _warm(self, **kw):
+        kw.setdefault("log_fn", _quiet)
+        c = CanaryController(**kw)
+        c.observe_shas(["sha-a"])
+        c.observe_shas(["sha-a", "sha-b"])
+        return c
+
+    def test_stride_split_honors_the_percentage(self):
+        c = self._warm(pct=25.0)
+        picks = [c.choose() for _ in range(100)]
+        assert picks.count("sha-b") == 25
+        assert picks.count("sha-a") == 75
+
+    def test_rollback_on_error_delta_pins_baseline(self):
+        sink = _Sink()
+        c = self._warm(pct=50.0, min_requests=10, max_err_delta=0.05,
+                       metrics=sink)
+        for _ in range(10):
+            c.record("sha-a", 200, 10.0)
+            c.record("sha-b", 500, 10.0)
+        assert c.evaluate() == "rollback"
+        ev = [e for e in sink.of("canary") if e["action"] == "rollback"]
+        assert len(ev) == 1 and ev[0]["sha"] == "sha-b"
+        assert c.pinned_sha() == "sha-a"
+        # every subsequent request serves the old weights
+        assert all(c.choose() == "sha-a" for _ in range(20))
+        # the rolled-back sha never becomes a canary again
+        c.observe_shas(["sha-a", "sha-b"])
+        assert c.summary()["canary_sha"] is None
+
+    def test_backpressure_is_not_a_canary_fault(self):
+        c = self._warm(pct=50.0, min_requests=10)
+        for _ in range(10):
+            c.record("sha-a", 200, 10.0)
+            c.record("sha-b", 429, 10.0)
+        for _ in range(10):
+            c.record("sha-b", 200, 10.0)
+        assert c.evaluate() != "rollback"
+
+    def test_healthy_canary_promotes_after_k_windows(self):
+        c = self._warm(pct=50.0, min_requests=5, promote_windows=2)
+        for _ in range(10):
+            c.record("sha-a", 200, 10.0)
+            c.record("sha-b", 200, 11.0)
+        assert c.evaluate() is None
+        assert c.evaluate() == "promote"
+        assert c.summary()["baseline_sha"] == "sha-b"
+
+
+# --------------------------------------------- replica chaos grammar ----
+class TestReplicaChaosGrammar:
+    def test_kill_replica_round_trips(self):
+        m = ChaosMonkey.parse("kill_replica=1,kill_req=40",
+                              metrics=_Sink(), log_fn=_quiet)
+        assert m.kill_replica == 1 and m.kill_req == 40
+
+    def test_slow_replica_round_trips(self):
+        m = ChaosMonkey.parse("slow_replica=2,slow_ms=75",
+                              log_fn=_quiet)
+        assert m.replica_slow_spec(2) == (2, pytest.approx(0.075))
+        assert m.replica_slow_spec(0) is None
+
+    @pytest.mark.parametrize("spec", ["kill_replica=x",
+                                      "kill_replicas=1"])
+    def test_bad_tokens_error_naming_the_token(self, spec):
+        with pytest.raises(ValueError) as ei:
+            ChaosMonkey.parse(spec, log_fn=_quiet)
+        assert spec.split(",")[0] in str(ei.value)
+
+    def test_replica_kill_due_is_one_shot(self):
+        sink = _Sink()
+        m = ChaosMonkey.parse("kill_replica=1,kill_req=3",
+                              metrics=sink, log_fn=_quiet)
+        assert not m.replica_kill_due(1, 2)     # not enough served
+        assert not m.replica_kill_due(0, 99)    # wrong replica
+        assert m.replica_kill_due(1, 3)
+        assert not m.replica_kill_due(1, 99)    # fired once, never again
+        assert len(sink.of("chaos")) == 1
+
+
+# ------------------------------------------------------ ServeFleetSim ----
+class TestServeFleetSim:
+    def test_flat_trace_loses_nothing(self):
+        s = ServeFleetSim(replicas=3, windows=10, rate=30.0, seed=3)
+        out = s.run()
+        assert out["lost"] == 0
+        assert out["arrivals"] == out["responses"]
+        assert out["arrivals"] > 100
+        assert out["errors"] == 0 and not out["quorum_lost"]
+
+    def test_replica_kill_evicts_and_loses_nothing(self):
+        chaos = ChaosMonkey.parse("kill_replica=1,kill_req=30",
+                                  log_fn=_quiet)
+        s = ServeFleetSim(replicas=3, windows=12, rate=40.0,
+                          chaos=chaos, seed=5)
+        out = s.run()
+        assert out["killed"] == [1]
+        assert out["evictions"] == 1
+        assert out["lost"] == 0      # every arrival got SOME response
+        assert out["retries"] > 0    # in-flight at death were retried
+
+    def test_churn_rejoin_is_readmitted(self):
+        s = ServeFleetSim(replicas=3, windows=16, rate=30.0,
+                          die_w=4, rejoin_w=9, seed=7)
+        out = s.run()
+        assert out["evictions"] == 1 and out["readmissions"] == 1
+        assert out["lost"] == 0
+        assert out["replicas_final"] == 3
+
+    def test_spike_trace_grows_the_fleet(self):
+        s = ServeFleetSim(replicas=2, windows=20, rate=60.0,
+                          trace="spike", spike_x=6.0, service_ms=40.0,
+                          slo_p99_ms=100.0, slo_depth=8,
+                          breach_windows=2, max_replicas=6, seed=11)
+        out = s.run()
+        assert out["grow"] >= 1
+        assert out["replicas_final"] > 2
+        assert out["lost"] == 0      # overload surfaced as 429s, not loss
+
+    def test_canary_rollback_drops_no_in_flight_requests(self):
+        s = ServeFleetSim(replicas=3, windows=16, rate=40.0,
+                          canary_w=5, canary_err=1.0,
+                          canary_min_requests=10, seed=13)
+        out = s.run()
+        assert out["canary_rollbacks"] == 1
+        assert out["lost"] == 0      # zero dropped in-flight requests
+        # old weights kept serving after the rollback
+        assert out["ok"] > 0 and not out["quorum_lost"]
+        assert out["replicas_final"] == 3
+
+    def test_unknown_trace_names_the_trace(self):
+        with pytest.raises(ValueError, match="nope"):
+            ServeFleetSim(trace="nope")
+
+
+# ------------------------------------------------------ serve sweep ----
+class TestServeSweep:
+    def test_parse_serve_grid_round_trips(self):
+        cells = sim_sweep.parse_serve_grid(
+            "replicas=2:3,trace=flat:spike,rate=20")
+        assert len(cells) == 4
+        assert cells[0]["replicas"] == 2 and cells[0]["trace"] == "flat"
+        assert all(c["rate"] == 20.0 for c in cells)
+
+    def test_bad_axis_errors_naming_the_token(self):
+        with pytest.raises(ValueError, match="bogus"):
+            sim_sweep.parse_serve_grid("bogus=1")
+
+    def test_run_serve_cell_and_table(self):
+        cells = sim_sweep.parse_serve_grid(
+            "replicas=2,windows=6,rate=20,kill_replica=1,kill_req=15")
+        results = sim_sweep.run_sweep(cells, log_fn=_quiet,
+                                      cell_fn=sim_sweep.run_serve_cell)
+        assert len(results) == 1
+        out = results[0]
+        assert out["lost"] == 0 and out["evictions"] == 1
+        table = sim_sweep.render_serve_table(results)
+        assert "lost" in table and "kill_replica=1" in table
