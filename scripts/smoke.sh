@@ -417,10 +417,14 @@ EOF
     for i in 0 1 2; do
         chaos=""
         [ "$i" = 1 ] && chaos="kill_replica=1,kill_req=25"
+        # replica 2 is chaos-slowed: the per-stage decomposition must
+        # attribute its milliseconds to the FORWARD (infer) stage — a
+        # slow accelerator, not queue wait — in `sparknet report`
+        [ "$i" = 2 ] && chaos="slow_replica=2,slow_ms=120"
         python -m sparknet_tpu serve --prefix "$rf/snapA" --port 0 \
             --fleet_dir "$rdv" --replica "$i" --replicas 3 \
             --lease 2 --heartbeat_interval 0.3 \
-            ${chaos:+--chaos "$chaos"} \
+            ${chaos:+--chaos "$chaos"} --trace_tail_ms 60 \
             --metrics "$rf/rep$i.jsonl" > "$rf/rep$i.out" 2>&1 &
         rpids+=($!)
     done
@@ -437,6 +441,7 @@ EOF
         --lease 2 --window_s 0.5 --slo_p99_ms 1 --breach_windows 3 \
         --idle_windows 9999 --max_replicas 4 \
         --canary_pct 25 --canary_min_requests 8 \
+        --trace_tail_ms 60 --slo_ms 60 --burn_scale 0.01 \
         --metrics "$rf/route.jsonl" > "$rf/route.out" 2>&1 &
     route_pid=$!
     for _ in $(seq 1 120); do
@@ -551,6 +556,18 @@ print(f"routefleet canary OK: rollback of {rb[0]['sha'][:12]} pinned "
       f"{b['ok']} ok / 0 errors")
 EOF
 
+    # phase 4: open-loop load (honest tail) — the bench reads the
+    # echoed X-Sparknet-Stages header and splits server-attributed
+    # milliseconds from network/client time
+    python -m sparknet_tpu serve-bench --url "$url" --mode open \
+        --rate 30 --duration 6 --json "$rf/bench4.json" \
+        > "$rf/bench4.out" 2>&1 || true
+    grep -q "serve-bench\[open\]" "$rf/bench4.out" || {
+        echo "phase-4 bench never ran:"; cat "$rf/bench4.out"; exit 1; }
+    grep -q "server share" "$rf/bench4.out" || {
+        echo "phase-4 bench missing the server/network split:"
+        cat "$rf/bench4.out"; exit 1; }
+
     kill -TERM "$route_pid"
     rc=0; wait "$route_pid" || rc=$?
     test "$rc" -eq 0 || { echo "router SIGTERM drain exited $rc:"
@@ -566,14 +583,78 @@ EOF
     done
 
     python -m sparknet_tpu report "$rf/route.jsonl" \
-        | tee "$rf/route.rep" > /dev/null
+        --json "$rf/route.repjson" | tee "$rf/route.rep" > /dev/null
     grep -q "routing fleet" "$rf/route.rep"
     grep -q "canary" "$rf/route.rep"
+    grep -q "p99 attribution" "$rf/route.rep"
+    grep -q "slo error budget" "$rf/route.rep"
     python -m sparknet_tpu monitor "$rf/route.jsonl" --once \
-        | grep -q "routing: dispatches"
+        > "$rf/route.mon"
+    grep -q "routing: dispatches" "$rf/route.mon"
+    grep -q "tracing: traces" "$rf/route.mon"
+
+    # "where did the p99 go": the decomposition must name the chaos-
+    # slowed replica's FORWARD stage as the top tail contributor (not
+    # queue wait), sum to the tail-cohort total within 10%, and the
+    # error-budget ledger must have seen the burn
+    python - "$rf" <<'EOF'
+import json, sys
+rf = sys.argv[1]
+rep = json.load(open(rf + "/route.repjson"))
+tr = rep["tracing"]
+assert tr["traces"] > 0 and tr["tails"] >= 1, tr
+assert tr["top_stage"] == "infer", \
+    f"p99 misattributed: {tr.get('top_stage')} {tr.get('p99_attribution')}"
+attr = tr["p99_attribution"]
+s = sum(attr.values())
+assert abs(s - tr["p99_cohort_ms"]) <= 0.1 * tr["p99_cohort_ms"], \
+    (s, tr["p99_cohort_ms"], attr)
+bn = rep["slo_burn"]
+assert bn["evaluations"] > 0, bn
+b = next(r for r in json.load(open(rf + "/bench4.json"))
+         if r["mode"] == "open")
+assert "server_ms_p99" in b and "net_ms_p99" in b, sorted(b)
+print(f"routefleet tracing OK: top tail stage infer "
+      f"({attr['infer']:.1f} of {tr['p99_cohort_ms']:.1f} ms), "
+      f"{tr['tails']} tail exemplar(s), burn evaluated "
+      f"{bn['evaluations']}x, bench server p99 {b['server_ms_p99']}ms "
+      f"/ net p99 {b['net_ms_p99']}ms")
+EOF
+
+    # the merged Chrome timeline carries the traced request end to
+    # end: router + replica tracks share one trace id, and the tail
+    # exemplar is flagged in the span name
+    python -m sparknet_tpu trace "$rf/route.jsonl" "$rf/rep0.jsonl" \
+        "$rf/rep2.jsonl" "$rf/rep3.jsonl" --chrome "$rf/fleet.json" \
+        > "$rf/trace.out" 2>&1 || { echo "trace merge failed:"
+                                    cat "$rf/trace.out"; exit 1; }
+    python - "$rf" <<'EOF'
+import json, sys
+rf = sys.argv[1]
+doc = json.load(open(rf + "/fleet.json"))
+evs = doc["traceEvents"] if isinstance(doc, dict) else doc
+names = {e["pid"]: e["args"]["name"] for e in evs
+         if e.get("ph") == "M" and e.get("name") == "process_name"}
+router_pids = {p for p, n in names.items() if "router" in n}
+rep_pids = set(names) - router_pids   # replica streams align as hosts
+assert router_pids and rep_pids, names
+spans = [e for e in evs if e.get("ph") == "X"
+         and (e.get("args") or {}).get("trace")]
+rtr = {e["args"]["trace"] for e in spans if e["pid"] in router_pids}
+prt = {e["args"]["trace"] for e in spans if e["pid"] in rep_pids}
+shared = rtr & prt
+assert shared, (len(rtr), len(prt))
+tails = [e for e in spans if "[tail]" in e.get("name", "")]
+assert tails, "no tail exemplar span in the merged timeline"
+print(f"routefleet timeline OK: {len(shared)} trace id(s) span the "
+      f"router and replica tracks, {len(tails)} tail exemplar "
+      f"span(s) flagged")
+EOF
     echo "routefleet stage OK: lease eviction + bounded-availability" \
          "failover from the metrics stream, grow admission under load," \
-         "canary auto-rollback to the baseline, router drained exit 0"
+         "canary auto-rollback to the baseline, p99 attributed to the" \
+         "slow replica's forward stage, traced request end to end in" \
+         "the merged timeline, router drained exit 0"
 }
 
 # --------------------------------------- elastic world resizing ----

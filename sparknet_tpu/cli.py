@@ -968,7 +968,10 @@ def cmd_simfleet(args):
                 canary_pct=args.canary_pct, canary_err=args.canary_err,
                 canary_min_requests=args.canary_min_requests,
                 die_w=args.die_w, rejoin_w=args.rejoin_w,
-                chaos=args.chaos, seed=args.seed, metrics=metrics,
+                chaos=args.chaos, seed=args.seed,
+                trace_sample=args.trace_sample,
+                tail_ms=args.trace_tail_ms, slo_burn=args.slo_burn,
+                burn_scale=args.burn_scale, metrics=metrics,
                 log_fn=log)
             s = sim.run()
             print(f"servefleet: {s['replicas']} replicas x "
@@ -979,7 +982,17 @@ def cmd_simfleet(args):
                   f"{s['rejected']} rejected (429), {s['errors']} "
                   f"errors, {s['retries']} retried; lost {s['lost']}")
             print(f"availability {s['availability']}  "
-                  f"p99 {s['p99_ms']}ms")
+                  f"p99 {s['p99_ms']}ms"
+                  + (f"  top stage {s['top_stage']}"
+                     if s.get("top_stage") else ""))
+            if s.get("burn"):
+                b = s["burn"]
+                print(f"slo burn: fast x{b.get('fast')}"
+                      f"/{b.get('fast_long')} slow x{b.get('slow')}"
+                      f"/{b.get('slow_long')} budget left "
+                      f"{b.get('budget_left')}"
+                      + (f"  ALERT {b['alert']}" if b.get("alert")
+                         else ""))
             print(f"membership: {s['evictions']} evictions, "
                   f"{s['readmissions']} readmissions, "
                   f"{s['admissions']} admissions; final live "
@@ -1085,6 +1098,7 @@ def cmd_serve(args):
     from .utils.signals import SignalPolicy
     from .utils.metrics import MetricsLogger
     from .utils.exit_codes import EXIT_RECOVERY_ABORT
+    from .obs.tracing import TraceSampler
     from .serve import ServeEngine, Batcher, serve_http
 
     _apply_perf_flags(args)   # before any net is compiled
@@ -1125,6 +1139,8 @@ def cmd_serve(args):
                                batcher=batcher,
                                interval_s=args.heartbeat_interval,
                                lease_s=args.lease, metrics=metrics)
+    tracer = TraceSampler(sample=args.trace_sample,
+                          tail_ms=args.trace_tail_ms)
     # SIGTERM = the scheduler's preemption notice -> drain, exit 0
     policy = SignalPolicy(sigint="stop", sighup="none", sigterm="stop")
     with policy:
@@ -1133,7 +1149,7 @@ def cmd_serve(args):
                         reload_poll_s=args.reload_poll,
                         request_timeout_s=args.request_timeout,
                         member=member, chaos=chaos,
-                        replica=args.replica)
+                        replica=args.replica, tracer=tracer)
     if metrics:
         metrics.close()
     return rc
@@ -1146,6 +1162,7 @@ def cmd_route(args):
     auto-rollback. Exit 0 after a clean SIGTERM/SIGINT drain."""
     from .utils.signals import SignalPolicy
     from .utils.metrics import MetricsLogger
+    from .obs.tracing import BurnRateLedger, TraceSampler
     from .serve import (Router, SLOAutoscaler, CanaryController,
                         route_http)
 
@@ -1154,8 +1171,18 @@ def cmd_route(args):
         pct=args.canary_pct, min_requests=args.canary_min_requests,
         max_err_delta=args.canary_err_delta,
         max_p99_delta_ms=args.canary_p99_delta_ms, metrics=metrics)
+    tracer = TraceSampler(sample=args.trace_sample,
+                          tail_ms=args.trace_tail_ms)
+    slo = None
+    if not args.no_slo_burn:
+        slo = BurnRateLedger(
+            slo_ms=(args.slo_ms if args.slo_ms is not None
+                    else args.slo_p99_ms),
+            objective=args.slo_objective, scale=args.burn_scale,
+            metrics=metrics)
     router = Router(args.fleet_dir, replicas=args.replicas,
-                    lease_s=args.lease, canary=canary, metrics=metrics)
+                    lease_s=args.lease, canary=canary, metrics=metrics,
+                    tracer=tracer, slo=slo)
     autoscaler = None
     if not args.no_autoscale:
         autoscaler = SLOAutoscaler(
@@ -1946,6 +1973,20 @@ def main(argv=None):
     sf.add_argument("--rejoin_w", type=int, default=None,
                     help="(--serve) window at which a dead replica "
                          "rejoins")
+    sf.add_argument("--trace_sample", type=float, default=1.0,
+                    help="(--serve) serve_trace head-sampling rate "
+                         "(1.0 = every request)")
+    sf.add_argument("--trace_tail_ms", type=float, default=None,
+                    help="(--serve) always keep serve_trace exemplars "
+                         "at/above this latency, regardless of "
+                         "sampling")
+    sf.add_argument("--slo_burn", action="store_true",
+                    help="(--serve) track the SLO error budget and "
+                         "multi-window burn-rate alerts")
+    sf.add_argument("--burn_scale", type=float, default=1.0,
+                    help="(--serve) burn-rate window scale (0.01 "
+                         "shrinks the 5m/1h/6h windows 100x for "
+                         "short sims)")
     sf.set_defaults(fn=cmd_simfleet)
 
     sv = sub.add_parser(
@@ -2001,6 +2042,12 @@ def main(argv=None):
                          " (SIGKILL self after the 20th request) or "
                          "'slow_replica=0,slow_ms=50' "
                          "(resilience/chaos.py)")
+    sv.add_argument("--trace_sample", type=float, default=1.0,
+                    help="serve_trace head-sampling rate (1.0 = every "
+                         "request emits a trace event)")
+    sv.add_argument("--trace_tail_ms", type=float, default=250.0,
+                    help="always keep serve_trace exemplars at/above "
+                         "this latency, regardless of sampling")
     _add_perf_flags(sv, scan=True)
     sv.set_defaults(fn=cmd_serve)
 
@@ -2056,6 +2103,23 @@ def main(argv=None):
     rt.add_argument("--metrics", help="JSONL metrics output path "
                                       "(route/scale/canary + "
                                       "membership events)")
+    rt.add_argument("--trace_sample", type=float, default=1.0,
+                    help="serve_trace head-sampling rate at the "
+                         "router (1.0 = every request)")
+    rt.add_argument("--trace_tail_ms", type=float, default=250.0,
+                    help="always keep serve_trace exemplars at/above "
+                         "this latency, regardless of sampling")
+    rt.add_argument("--slo_ms", type=float, default=None,
+                    help="error-budget SLO latency bound (default: "
+                         "--slo_p99_ms)")
+    rt.add_argument("--slo_objective", type=float, default=0.999,
+                    help="error-budget availability objective "
+                         "(fraction of requests that must be good)")
+    rt.add_argument("--burn_scale", type=float, default=1.0,
+                    help="burn-rate window scale (0.01 shrinks the "
+                         "5m/1h/6h windows 100x for short runs)")
+    rt.add_argument("--no_slo_burn", action="store_true",
+                    help="disable the SLO error-budget ledger")
     rt.set_defaults(fn=cmd_route)
 
     sb = sub.add_parser(

@@ -175,8 +175,16 @@ EVENTS = {
         "fields": ['batch_fill', 'batches', 'drained', 'latency_ms_p50', 'latency_ms_p95', 'latency_ms_p99', 'rejects', 'reloads', 'requests', 'rows', 'rps', 'uptime_s'],
         "open": False,
     },
+    'serve_trace': {
+        "fields": ['attempts', 'batch_ms', 'code', 'fulfill_ms', 'infer_ms', 'net_ms', 'queue_ms', 'replica', 'retried', 'server_ms', 'spans', 'src', 'tail', 'total_ms', 'trace'],
+        "open": False,
+    },
     'sim': {
         "fields": ['admissions', 'dead', 'evictions', 'hosts', 'live', 'parked', 'readmissions', 'round', 't_s', 'wait_s'],
+        "open": False,
+    },
+    'slo_burn': {
+        "fields": ['alert', 'bad', 'budget_left', 'fast', 'fast_long', 'good', 'slow', 'slow_long'],
         "open": False,
     },
     'span': {

@@ -84,6 +84,16 @@ def split_streams(streams):
         self_ids = {ev.get("observer") for ev in events
                     if ev.get("event") in ("host_round", "trace_align")
                     and isinstance(ev.get("observer"), int)}
+        if not self_ids and not owners:
+            # a serving-tier stream (router or replica) has no beacon
+            # observer ints; its serve_trace events name the writer in
+            # ``src`` ("router", "replica0", ...) — one distinct src
+            # means the whole file is that host's track
+            srcs = {ev.get("src") for ev in events
+                    if ev.get("event") == "serve_trace"} - {None}
+            if len(srcs) == 1:
+                out[next(iter(srcs))].extend(events)
+                continue
         self_ids = self_ids or owners
         if len(self_ids) == 1:
             out[next(iter(self_ids))].extend(events)
@@ -264,9 +274,11 @@ def merge_streams(streams):
 
 #: synthetic track (tid) layout inside each host's process group
 _TID_ROUNDS, _TID_IO, _TID_H2D, _TID_STEPS, _TID_SPANS = 0, 1, 2, 3, 4
+_TID_SERVE = 5
 
 _TRACK_NAMES = {_TID_ROUNDS: "rounds", _TID_IO: "relay/consensus",
-                _TID_H2D: "h2d", _TID_STEPS: "steps", _TID_SPANS: "spans"}
+                _TID_H2D: "h2d", _TID_STEPS: "steps",
+                _TID_SPANS: "spans", _TID_SERVE: "serve"}
 
 
 def _x(name, ts_s, dur_s, pid, tid, args):
@@ -343,6 +355,48 @@ def _host_events(ft, host, pid):
                                  "dur_ms", "tid", "name")}
             evs.append(_x(str(ev.get("name", "span")), at - dur, dur,
                           pid, _TID_SPANS, args))
+        elif kind == "serve_trace":
+            # one traced serve request, end-anchored at its emit time.
+            # Router events nest their per-attempt dispatch spans;
+            # replica events nest the stage breakdown. The shared
+            # trace id in args is what correlates the router's span
+            # with the replica's across process tracks.
+            total_s = float(ev.get("total_ms")
+                            or ev.get("server_ms") or 0.0) / 1e3
+            trace = ev.get("trace")
+            start = at - total_s
+            args = {k: ev.get(k) for k in
+                    ("trace", "replica", "code", "attempts", "retried",
+                     "tail", "net_ms", "queue_ms", "batch_ms",
+                     "infer_ms", "fulfill_ms")
+                    if ev.get(k) is not None}
+            name = f"req {trace}" if trace else "req"
+            if ev.get("tail"):
+                name += " [tail]"
+            evs.append(_x(name, start, total_s, pid, _TID_SERVE, args))
+            spans = ev.get("spans")
+            if spans:
+                for sp in spans:
+                    if not isinstance(sp, dict):
+                        continue
+                    dur = float(sp.get("dur_ms") or 0.0) / 1e3
+                    evs.append(_x(
+                        f"dispatch r{sp.get('replica')}",
+                        start + float(sp.get("start_ms") or 0.0) / 1e3,
+                        dur, pid, _TID_SERVE,
+                        {"trace": trace, "replica": sp.get("replica"),
+                         "code": sp.get("code")}))
+            else:
+                cursor = start
+                for stage in ("net", "queue", "batch", "infer",
+                              "fulfill"):
+                    dur_ms = ev.get(f"{stage}_ms")
+                    if not isinstance(dur_ms, (int, float)) \
+                            or dur_ms <= 0:
+                        continue
+                    evs.append(_x(stage, cursor, dur_ms / 1e3, pid,
+                                  _TID_SERVE, {"trace": trace}))
+                    cursor += dur_ms / 1e3
         elif kind == "chaos":
             evs.append(_i(f"chaos {ev.get('kind')}", at, pid,
                           _TID_ROUNDS,

@@ -112,6 +112,15 @@ class MonitorState:
         self.scale_events = []      # (action, reason, live)
         self.last_canary = None
         self.canary_rollbacks = 0
+        # request tracing + SLO burn (obs/tracing.py, ISSUE 18)
+        self.trace_count = 0
+        self.trace_tails = 0
+        self.trace_stage_ms = {
+            k: collections.deque(maxlen=2048)
+            for k in ("net", "queue", "batch", "infer", "fulfill")}
+        self.trace_total_ms = collections.deque(maxlen=2048)
+        self.last_burn = None
+        self.burn_alerts = collections.Counter()
         self.done = None            # summary event, if the run finished
 
     def update(self, ev):               # spk: thread-entry
@@ -273,6 +282,19 @@ class MonitorState:
             self.last_canary = ev
             if ev.get("action") == "rollback":
                 self.canary_rollbacks += 1
+        elif kind == "serve_trace":
+            self.trace_count += 1
+            if ev.get("tail"):
+                self.trace_tails += 1
+            if _num(ev.get("total_ms")):
+                self.trace_total_ms.append(ev["total_ms"])
+            for k, dq in self.trace_stage_ms.items():
+                if _num(ev.get(f"{k}_ms")):
+                    dq.append(ev[f"{k}_ms"])
+        elif kind == "slo_burn":
+            self.last_burn = ev
+            if ev.get("alert"):
+                self.burn_alerts[str(ev["alert"])] += 1
         elif kind == "summary":
             self.done = ev
 
@@ -493,6 +515,37 @@ class MonitorState:
                 if self.canary_rollbacks:
                     line += f"  rollbacks {self.canary_rollbacks}"
                 L.append(line)
+        if self.trace_count:
+            from .stepstats import percentiles
+            bits = [f"traces {self.trace_count}",
+                    f"tails {self.trace_tails}"]
+            if self.trace_total_ms:
+                p = percentiles(list(self.trace_total_ms))
+                bits.append(f"total p99 {p['p99']:.1f}ms")
+            stage_p99 = {k: percentiles(list(dq))["p99"]
+                         for k, dq in self.trace_stage_ms.items() if dq}
+            if stage_p99:
+                top = max(stage_p99.items(), key=lambda kv: kv[1])
+                bits.append(f"top stage {top[0]} ({top[1]:.1f}ms)")
+            L.append("  tracing: " + "  ".join(bits))
+            if stage_p99:
+                L.append("    stage p99: " + "  ".join(
+                    f"{k} {v:.1f}ms"
+                    for k, v in sorted(stage_p99.items(),
+                                       key=lambda kv: -kv[1])))
+        if self.last_burn is not None:
+            b = self.last_burn
+            bits = [f"fast x{b.get('fast')}/{b.get('fast_long')}",
+                    f"slow x{b.get('slow')}/{b.get('slow_long')}"]
+            if _num(b.get("budget_left")):
+                bits.append(f"budget left {b['budget_left']:.1%}")
+            if b.get("alert"):
+                bits.append(f"ALERT {b['alert']}")
+            if self.burn_alerts:
+                bits.append("alerts " + " ".join(
+                    f"{k}:{n}" for k, n in sorted(
+                        self.burn_alerts.items())))
+            L.append("  slo burn: " + "  ".join(bits))
         if self.straggler_counts:
             worst = self.straggler_counts.most_common(1)[0]
             L.append(f"  stragglers: worker {worst[0]} flagged "

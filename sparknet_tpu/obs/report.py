@@ -538,6 +538,67 @@ def aggregate(events):
             fl["canary_rollbacks"] = sum(
                 1 for e in cn if e.get("action") == "rollback")
         rep["routing"] = fl
+
+    # -- request tracing (obs/tracing.py: serve_trace) ---------------------
+    trc = [e for e in events if e.get("event") == "serve_trace"]
+    if trc:
+        # prefer the router's view (it closes the loop with net time);
+        # replica-only streams still decompose their own stages
+        rows = [e for e in trc if e.get("src") == "router"] or trc
+        tr = {"traces": len(trc),
+              "tails": sum(1 for e in trc if e.get("tail")),
+              "retried": sum(1 for e in rows if e.get("retried"))}
+        stage_keys = ("net", "queue", "batch", "infer", "fulfill")
+        stages = {}
+        for k in stage_keys:
+            vals = [e[f"{k}_ms"] for e in rows
+                    if _num(e.get(f"{k}_ms"))]
+            if vals:
+                stages[k] = {q: round(v, 3)
+                             for q, v in percentiles(vals).items()}
+        if stages:
+            tr["stages"] = stages
+        totals = [e["total_ms"] for e in rows
+                  if _num(e.get("total_ms"))]
+        if totals:
+            tr["p99_total_ms"] = round(percentiles(totals)["p99"], 3)
+            # "where did the p99 go": per-stage MEANS over the tail
+            # cohort (total >= p99 threshold). Means over one cohort
+            # sum to the cohort's mean total — unlike per-stage p99s,
+            # which need not sum to anything — so the attribution is
+            # checkable: sum(stages) ≈ cohort total
+            thresh = percentiles(totals)["p99"]
+            cohort = [e for e in rows if _num(e.get("total_ms"))
+                      and e["total_ms"] >= thresh]
+            attr = {}
+            for k in stage_keys:
+                vals = [e[f"{k}_ms"] for e in cohort
+                        if _num(e.get(f"{k}_ms"))]
+                if vals:
+                    attr[k] = round(sum(vals) / len(vals), 3)
+            if attr:
+                tr["p99_attribution"] = attr
+                tr["p99_cohort_ms"] = round(
+                    sum(e["total_ms"] for e in cohort) / len(cohort), 3)
+                tr["top_stage"] = max(attr.items(),
+                                      key=lambda kv: kv[1])[0]
+        rep["tracing"] = tr
+
+    # -- SLO error budget (obs/tracing.py: slo_burn) -----------------------
+    brn = [e for e in events if e.get("event") == "slo_burn"]
+    if brn:
+        alerts = collections.Counter(
+            str(e.get("alert")) for e in brn if e.get("alert"))
+        peak = max((e["fast"] for e in brn if _num(e.get("fast"))),
+                   default=None)
+        last = brn[-1]
+        rep["slo_burn"] = {
+            "evaluations": len(brn),
+            "alerts": dict(alerts),
+            "peak_fast_burn": None if peak is None else round(peak, 3),
+            "last": {k: last.get(k) for k in
+                     ("alert", "fast", "fast_long", "slow",
+                      "slow_long", "budget_left", "good", "bad")}}
     return rep
 
 
@@ -1012,6 +1073,41 @@ def render(rep):
                 bits.append(f"err {e['err_rate']:.2%} vs "
                             f"{(e.get('base_err_rate') or 0):.2%}")
             L.append(" ".join(bits))
+    tr = rep.get("tracing")
+    if tr:
+        hdr("request tracing")
+        L.append(f"  traces: {tr.get('traces', 0)} "
+                 f"({tr.get('tails', 0)} tail exemplar(s), "
+                 f"{tr.get('retried', 0)} retried)")
+        for k, st in (tr.get("stages") or {}).items():
+            L.append(f"  {k:>8}  " + "  ".join(
+                f"{q}={st[q]:.3f}" for q in ("p50", "p95", "p99")
+                if _num(st.get(q))) + " ms")
+        attr = tr.get("p99_attribution")
+        if attr:
+            total = tr.get("p99_cohort_ms") or sum(attr.values())
+            top = tr.get("top_stage")
+            L.append(f"  p99 attribution (where did the p99 go): "
+                     f"top stage {top} "
+                     f"({attr.get(top, 0):.3f} of {total:.3f} ms)")
+            L.append("    " + "  ".join(
+                f"{k}={v:.3f}" for k, v in attr.items()) + " ms")
+    bn = rep.get("slo_burn")
+    if bn:
+        hdr("slo error budget")
+        last = bn.get("last") or {}
+        line = (f"  burn rate: fast x{last.get('fast')}"
+                f"/{last.get('fast_long')}, "
+                f"slow x{last.get('slow')}/{last.get('slow_long')}")
+        if _num(last.get("budget_left")):
+            line += f", budget left {last['budget_left']:.1%}"
+        L.append(line)
+        alerts = bn.get("alerts") or {}
+        L.append("  alerts: " + (", ".join(
+            f"{k}: {v}" for k, v in sorted(alerts.items()))
+            if alerts else "none") +
+            f" (peak fast burn x{bn.get('peak_fast_burn')}, "
+            f"{bn.get('evaluations', 0)} evaluation(s))")
     L.append("")
     return "\n".join(L)
 

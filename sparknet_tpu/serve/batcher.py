@@ -41,17 +41,26 @@ class Request:
     ``done.wait()`` returns — the Event is the fence."""
 
     __slots__ = ("arrays", "n", "t_submit", "done", "result", "error",
-                 "t_done", "bucket")
+                 "t_done", "bucket", "trace", "t_enq", "t_dispatch",
+                 "t_fwd0", "t_fwd1")
 
-    def __init__(self, arrays, n):
+    def __init__(self, arrays, n, trace=None):
         self.arrays = arrays
         self.n = int(n)
-        self.t_submit = time.monotonic()
+        self.t_submit = time.monotonic()   # admission stamp
         self.done = threading.Event()
         self.result = None
         self.error = None
         self.t_done = None
         self.bucket = None
+        # request-tracing fields (obs/tracing.py): the router-minted
+        # trace id plus per-stage monotonic stamps. Each stamp is
+        # written by exactly one thread strictly before done.set().
+        self.trace = trace
+        self.t_enq = None        # queued in the batcher
+        self.t_dispatch = None   # popped into a batch
+        self.t_fwd0 = None       # engine.forward started
+        self.t_fwd1 = None       # engine.forward returned
 
     def wait(self, timeout=None):
         return self.done.wait(timeout)
@@ -71,11 +80,11 @@ class Batcher:
         self._submitted = 0               # spk: guarded-by=_cv
         self._rejected = 0                # spk: guarded-by=_cv
 
-    def submit(self, arrays, n=1):        # spk: thread-entry
+    def submit(self, arrays, n=1, trace=None):  # spk: thread-entry
         """Queue one request from a handler thread; returns the Request
         to wait on, or raises RejectedError when over queue_limit or
         draining (emitting the serve_reject event)."""
-        req = Request(arrays, n)
+        req = Request(arrays, n, trace=trace)
         reject = None
         with self._cv:
             if self._closed:
@@ -87,6 +96,7 @@ class Batcher:
                 reject = ("queue_full", self._rows)
             else:
                 self._submitted += 1
+                req.t_enq = time.monotonic()
                 self._q.append(req)
                 self._rows += req.n
                 self._cv.notify()
@@ -130,7 +140,10 @@ class Batcher:
                 out.append(req)
                 rows = req.n
             self._rows -= rows
-        wait_ms = (time.monotonic() - out[0].t_submit) * 1e3 if out else 0.0
+        now = time.monotonic()
+        for req in out:
+            req.t_dispatch = now     # queue -> batch stage boundary
+        wait_ms = (now - out[0].t_submit) * 1e3 if out else 0.0
         return out, wait_ms
 
     def depth(self):                      # spk: thread-entry

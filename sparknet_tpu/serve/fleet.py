@@ -41,9 +41,12 @@ Router runs against SimClock/MemDir in `sparknet simfleet --serve`
 (sim/servefleet.py) and against the wall clock on metal.
 """
 
+import inspect
 import json
 import threading
 
+from ..obs.tracing import (STAGES_HEADER, TRACE_HEADER, StageReservoir,
+                           decode_stages, encode_stages)
 from ..resilience.elastic import ElasticPolicy, QuorumLost
 from ..resilience.heartbeat import HeartbeatCoordinator
 from ..resilience.seam import WALL_CLOCK, RealDir
@@ -53,26 +56,31 @@ def _drain_name(replica):
     return f"drain-{int(replica)}.json"
 
 
-def http_post(url, body, timeout):
+def http_post(url, body, timeout, headers=None):
     """The real dispatch half: POST ``body`` to ``url``/predict.
-    Returns (status, payload bytes); status -1 means NO response was
-    received (connect refused, reset, timeout) — the only case a retry
-    is provably safe-or-necessary for."""
+    Returns (status, payload bytes, None, stages) — stages is the
+    replica's echoed X-Sparknet-Stages breakdown ({stage: ms}) or
+    None; status -1 means NO response was received (connect refused,
+    reset, timeout) — the only case a retry is provably
+    safe-or-necessary for."""
     from urllib.error import HTTPError, URLError
     from urllib.request import Request, urlopen
+    hdrs = {"Content-Type": "application/json"}
+    hdrs.update(headers or {})
     try:
         req = Request(url.rstrip("/") + "/predict", data=body,
-                      headers={"Content-Type": "application/json"})
+                      headers=hdrs)
         with urlopen(req, timeout=timeout) as r:
-            return r.status, r.read()
+            return (r.status, r.read(), None,
+                    decode_stages(r.headers.get(STAGES_HEADER)))
     except HTTPError as e:
         try:
             data = e.read()
         except OSError:
             data = b""
-        return e.code, data
+        return e.code, data, None, None
     except (URLError, OSError, TimeoutError):
-        return -1, b""
+        return -1, b"", None, None
 
 
 class ReplicaMember:
@@ -180,8 +188,13 @@ class SLOAutoscaler:
         count."""
         p99 = stats.get("p99_ms")
         depth = stats.get("queue_depth") or 0
+        # a paging burn rate (obs/tracing.py BurnRateLedger) is an
+        # EARLIER breach signal than the raw p99 gate: the fast window
+        # confirms the budget is burning right now, before enough slow
+        # windows accumulate for the p99 threshold to trip
+        burn_page = (stats.get("burn") or {}).get("alert") == "page"
         breach = (p99 is not None and p99 > self.p99_ms) \
-            or depth > self.depth
+            or depth > self.depth or burn_page
         idle = stats.get("requests", 0) == 0 and depth == 0
         self._breach = self._breach + 1 if breach else 0
         self._idle = self._idle + 1 if idle else 0
@@ -189,8 +202,12 @@ class SLOAutoscaler:
         if self._breach >= self.windows:
             if live < self.max_replicas:
                 action = "grow"
-                reason = ("p99_breach" if p99 is not None
-                          and p99 > self.p99_ms else "depth_breach")
+                if p99 is not None and p99 > self.p99_ms:
+                    reason = "p99_breach"
+                elif depth > self.depth:
+                    reason = "depth_breach"
+                else:
+                    reason = "burn_rate"
             self._breach = 0     # re-arm either way (hysteresis)
         elif self._idle >= self.idle_windows:
             if live > self.min_replicas:
@@ -405,7 +422,8 @@ class Router:
 
     def __init__(self, directory, replicas=1, lease_s=3.0, quorum=1,
                  canary=None, metrics=None, log_fn=print, clock=None,
-                 dirops=None, post_fn=None, retry=True):
+                 dirops=None, post_fn=None, retry=True, tracer=None,
+                 slo=None):
         self.dir = str(directory)
         self.clock = WALL_CLOCK if clock is None else clock
         self.dirops = RealDir(self.dir) if dirops is None else dirops
@@ -415,6 +433,21 @@ class Router:
         self.post_fn = http_post if post_fn is None else post_fn
         self.retry = bool(retry)
         self.canary = canary
+        # request tracing (obs/tracing.py): the router mints the trace
+        # id, closes the loop on the replica's echoed stage breakdown
+        # (net = total − server-reported), and keeps per-stage
+        # reservoirs for /metrics and the p99 decomposition. ``slo``
+        # is an optional BurnRateLedger fed from dispatch outcomes.
+        self.tracer = tracer
+        self.slo = slo
+        self.stages = StageReservoir()
+        try:
+            params = inspect.signature(self.post_fn).parameters
+            self._post_headers = "headers" in params or any(
+                p.kind == inspect.Parameter.VAR_KEYWORD
+                for p in params.values())
+        except (TypeError, ValueError):
+            self._post_headers = False
         self.policy = ElasticPolicy(
             n_workers=max(1, int(replicas)), quorum=max(1, int(quorum)),
             evict_after=1, readmit_after=0, metrics=metrics,
@@ -431,6 +464,8 @@ class Router:
         self._win_reqs = 0                # spk: guarded-by=_lock
         self._win_errs = 0                # spk: guarded-by=_lock
         self._rr = 0                      # spk: guarded-by=_lock
+        self._trace_n = 0                 # spk: guarded-by=_lock
+        self._by_replica = {}             # spk: guarded-by=_lock
         self.requests = 0                 # spk: guarded-by=_lock
         self.ok = 0                       # spk: guarded-by=_lock
         self.rejected = 0                 # spk: guarded-by=_lock
@@ -558,18 +593,32 @@ class Router:
         _, r, rec = mins[rr % len(mins)]
         return r, rec.get("url"), rec.get("sha")
 
-    def dispatch(self, body, timeout=30.0):
+    def dispatch(self, body, timeout=30.0, want_headers=False):
         """Route one POST /predict body. Returns (status, payload
-        bytes). Transport failure (no response) retries ONCE on a
-        different replica; any received response — including errors —
-        is final (a fulfilled request is never doubled). No live
-        non-draining replica -> 503 immediately, never a hang."""
+        bytes) — or (status, payload, echo-headers dict) with
+        ``want_headers`` so the router front end can re-echo the
+        replica's stage breakdown to the client. Transport failure (no
+        response) retries ONCE on a different replica; any received
+        response — including errors — is final (a fulfilled request is
+        never doubled). No live non-draining replica -> 503
+        immediately, never a hang.
+
+        Mints one trace id per request and propagates it to every
+        attempt via the X-Sparknet-Trace header (value
+        "<id>;<attempt>" — retries share the id); collects one span
+        per attempt plus the replica's echoed stage breakdown so a
+        traced request attributes its milliseconds end to end."""
         t0 = self.clock.monotonic()
+        with self._lock:
+            self._trace_n += 1
+            trace = f"t{self._trace_n:08x}"
         want_sha = self.canary.choose() if self.canary is not None \
             else None
         tried = []
+        spans = []
         code, data, replica, sha = -1, b"", None, None
         sim_lat_ms = None
+        stages_resp = None
         for attempt in (1, 2):
             picked = self.pick(exclude=tried, sha=want_sha)
             if picked is None and want_sha is not None:
@@ -584,15 +633,28 @@ class Router:
                 self._inflight[replica] = \
                     self._inflight.get(replica, 0) + 1
                 self._sent[replica] = self._sent.get(replica, 0) + 1
+                self._by_replica[replica] = \
+                    self._by_replica.get(replica, 0) + 1
+            att0 = self.clock.monotonic()
             try:
-                # post_fn may return (code, body) — the real HTTP
-                # transport — or (code, body, latency_ms) from a
-                # simulated replica (sim/servefleet.py), whose service
-                # time is computed, not slept
-                res = self.post_fn(url, body, timeout)
+                # post_fn may return (code, body) — the legacy HTTP
+                # transport shape — (code, body, latency_ms) from a
+                # simulated replica (sim/servefleet.py) whose service
+                # time is computed, not slept, or (code, body,
+                # latency_ms, stages) when the replica echoes its
+                # stage breakdown
+                if self._post_headers:
+                    res = self.post_fn(
+                        url, body, timeout,
+                        headers={TRACE_HEADER: f"{trace};{attempt}"})
+                else:
+                    res = self.post_fn(url, body, timeout)
                 code, data = res[0], res[1]
+                att_lat = None
                 if len(res) > 2 and res[2] is not None:
-                    sim_lat_ms = float(res[2])
+                    sim_lat_ms = att_lat = float(res[2])
+                if len(res) > 3:
+                    stages_resp = res[3]
             finally:
                 with self._lock:
                     n = self._inflight.get(replica, 1) - 1
@@ -600,6 +662,11 @@ class Router:
                         self._inflight.pop(replica, None)
                     else:
                         self._inflight[replica] = n
+            if att_lat is None:
+                att_lat = (self.clock.monotonic() - att0) * 1e3
+            spans.append({"replica": int(replica), "code": int(code),
+                          "start_ms": round((att0 - t0) * 1e3, 3),
+                          "dur_ms": round(att_lat, 3)})
             if code == 200 or not self.retry:
                 break
             if code not in (-1, 429):
@@ -631,12 +698,49 @@ class Router:
                 self.retries += 1
             if not tried:
                 self.no_replica += 1
+        # close the tracing loop: net = router total − server-reported
+        server_ms = net_ms = None
+        stg = None
+        if code == 200 and stages_resp:
+            server_ms = stages_resp.get("total")
+            if server_ms is not None:
+                net_ms = max(0.0, latency_ms - float(server_ms))
+            stg = {"net": net_ms,
+                   "queue": stages_resp.get("queue"),
+                   "batch": stages_resp.get("batch"),
+                   "infer": stages_resp.get("infer"),
+                   "fulfill": stages_resp.get("fulfill"),
+                   "total": latency_ms}
+            self.stages.add(stg)
+        if self.slo is not None:
+            self.slo.record(self.clock.monotonic(),
+                            self.slo.good(code, latency_ms))
         if self.canary is not None and sha is not None:
             self.canary.record(sha, code, latency_ms)
         if self.metrics is not None:
             self.metrics.log("route", replica=replica, code=int(code),
                              attempts=len(tried), retried=retried,
                              latency_ms=round(latency_ms, 3), sha=sha)
+            verdict = self.tracer.decide(latency_ms) \
+                if self.tracer is not None else None
+            if verdict is not None:
+                rnd = lambda v: None if v is None else round(v, 3)  # noqa: E731
+                self.metrics.log(
+                    "serve_trace", src="router", trace=trace,
+                    replica=replica, code=int(code),
+                    attempts=len(tried), retried=retried,
+                    total_ms=round(latency_ms, 3),
+                    server_ms=rnd(server_ms), net_ms=rnd(net_ms),
+                    queue_ms=rnd(stg["queue"]) if stg else None,
+                    batch_ms=rnd(stg["batch"]) if stg else None,
+                    infer_ms=rnd(stg["infer"]) if stg else None,
+                    fulfill_ms=rnd(stg["fulfill"]) if stg else None,
+                    tail=verdict == "tail", spans=spans)
+        if want_headers:
+            echo = {TRACE_HEADER: trace}
+            if stages_resp:
+                echo[STAGES_HEADER] = encode_stages(stages_resp)
+            return code, data, echo
         return code, data
 
     # -- observation --------------------------------------------------------
@@ -656,15 +760,32 @@ class Router:
                "queue_depth": depth,
                "p99_ms": (round(percentiles(lats)["p99"], 3)
                           if lats else None)}
+        if self.slo is not None:
+            # evaluated once per window (not per request) so the
+            # slo_burn event volume rides the window cadence; the
+            # autoscaler reads the verdict as an earlier breach signal
+            out["burn"] = self.slo.evaluate(self.clock.monotonic())
         return out
 
     def stats_snapshot(self):             # spk: thread-entry
         with self._lock:
-            return {"requests": self.requests, "ok": self.ok,
-                    "rejected": self.rejected, "errors": self.errors,
-                    "retries": self.retries,
-                    "no_replica": self.no_replica,
-                    "live": self.policy.live_count()}
+            by_rep = dict(self._by_replica)
+            out = {"requests": self.requests, "ok": self.ok,
+                   "rejected": self.rejected, "errors": self.errors,
+                   "retries": self.retries,
+                   "no_replica": self.no_replica,
+                   "live": self.policy.live_count()}
+        out["retry_rate"] = round(out["retries"]
+                                  / out["requests"], 4) \
+            if out["requests"] else 0.0
+        total = sum(by_rep.values())
+        out["dispatch_share"] = {
+            str(r): round(n / total, 4)
+            for r, n in sorted(by_rep.items())} if total else {}
+        out["stages"] = self.stages.snapshot()
+        if self.slo is not None:
+            out["slo_burn"] = self.slo.snapshot()
+        return out
 
     def status(self):                     # spk: thread-entry
         """GET /healthz: the router's membership truth."""
@@ -679,6 +800,9 @@ class Router:
                    ("url", "queue_depth", "in_flight", "draining",
                     "sha", "iter", "round")} for r, rec in
                    sorted(leases.items())}}
+        out["stages_p99"] = self.stages.p99()
+        if self.slo is not None:
+            out["slo_burn"] = self.slo.snapshot()
         if self.canary is not None:
             out["canary"] = self.canary.summary()
         return out
@@ -693,10 +817,13 @@ def _make_router_handler(router, timeout_s):
         def log_message(self, fmt, *args):   # quiet access log
             pass
 
-        def _send(self, code, body, ctype="application/json"):
+        def _send(self, code, body, ctype="application/json",
+                  headers=None):
             self.send_response(code)
             self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(body)))
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
             self.end_headers()
             self.wfile.write(body)
 
@@ -743,8 +870,9 @@ def _make_router_handler(router, timeout_s):
                 return
             length = int(self.headers.get("Content-Length", 0))
             body = self.rfile.read(length)
-            code, data = router.dispatch(body, timeout=timeout_s)
-            self._send(code, data)
+            code, data, hdrs = router.dispatch(
+                body, timeout=timeout_s, want_headers=True)
+            self._send(code, data, headers=hdrs)
 
     return Handler
 

@@ -16,6 +16,8 @@ import time
 
 import numpy as np
 
+from ..obs.tracing import STAGES_HEADER, decode_stages
+
 
 def _discover(url, timeout=5.0):
     """GET /healthz -> feed shapes the payload must match."""
@@ -42,16 +44,22 @@ class _Recorder:
     def __init__(self):
         self._lock = threading.Lock()
         self.lat_ms = []
+        self.srv_ms = []    # server-attributed (echoed stage header)
+        self.net_ms = []    # client-observed minus server-attributed
         self.ok = 0
         self.rejected = 0
         self.errors = 0
         self.dropped = 0
 
-    def add(self, code, ms):              # spk: thread-entry
+    def add(self, code, ms, server_ms=None):  # spk: thread-entry
         with self._lock:
             if code == 200:
                 self.ok += 1
                 self.lat_ms.append(ms)
+                if server_ms is not None:
+                    self.srv_ms.append(float(server_ms))
+                    self.net_ms.append(
+                        max(0.0, ms - float(server_ms)))
             elif code == 429:
                 self.rejected += 1
             else:
@@ -65,6 +73,8 @@ class _Recorder:
         from ..obs.stepstats import percentiles
         with self._lock:
             lats = list(self.lat_ms)
+            srv = list(self.srv_ms)
+            net = list(self.net_ms)
             out = {"ok": self.ok, "rejected": self.rejected,
                    "errors": self.errors, "dropped": self.dropped}
         out["requests"] = out["ok"] + out["rejected"] + out["errors"]
@@ -73,6 +83,15 @@ class _Recorder:
                         for k, v in percentiles(lats).items()})
             out["latency_ms_mean"] = round(float(np.mean(lats)), 3)
             out["latency_ms_max"] = round(float(np.max(lats)), 3)
+        if srv:
+            # server-attributed vs network/client share: when these
+            # disagree with the client-observed numbers, the missing
+            # milliseconds are on the wire or in the client, not in
+            # the server's batcher/forward path
+            out.update({f"server_ms_{k}": round(v, 3)
+                        for k, v in percentiles(srv).items()})
+            out.update({f"net_ms_{k}": round(v, 3)
+                        for k, v in percentiles(net).items()})
         return out
 
 
@@ -80,18 +99,23 @@ def _fire(url, payload, rec, timeout):
     from urllib.request import urlopen, Request
     from urllib.error import HTTPError, URLError
     t0 = time.perf_counter()
+    server_ms = None
     try:
         req = Request(url.rstrip("/") + "/predict", data=payload,
                       headers={"Content-Type": "application/json"})
         with urlopen(req, timeout=timeout) as r:
             code = r.status
             r.read()
+            stg = decode_stages(r.headers.get(STAGES_HEADER))
+            if stg:
+                server_ms = stg.get("total")
     except HTTPError as e:
         code = e.code
         e.read()
     except (URLError, OSError, TimeoutError):
         code = -1
-    rec.add(code, (time.perf_counter() - t0) * 1e3)
+    rec.add(code, (time.perf_counter() - t0) * 1e3,
+            server_ms=server_ms)
 
 
 def run_loadgen(url, mode="closed", concurrency=4, rate=50.0,
@@ -166,6 +190,12 @@ def run_loadgen(url, mode="closed", concurrency=4, rate=50.0,
         f"p50={out.get('latency_ms_p50')} "
         f"p95={out.get('latency_ms_p95')} "
         f"p99={out.get('latency_ms_p99')} ms")
+    if "server_ms_p99" in out:
+        log(f"serve-bench[{mode}]: server share "
+            f"p50={out['server_ms_p50']} p95={out['server_ms_p95']} "
+            f"p99={out['server_ms_p99']} ms; network/client "
+            f"p50={out['net_ms_p50']} p95={out['net_ms_p95']} "
+            f"p99={out['net_ms_p99']} ms")
     if metrics is not None:
         metrics.log("bench", kind="serve", **out)
     return out

@@ -28,6 +28,29 @@ import time
 import numpy as np
 
 from .batcher import RejectedError
+from ..obs.tracing import STAGES_HEADER, TRACE_HEADER, encode_stages
+
+
+def stage_breakdown(req, now):
+    """Per-stage wall attribution for one fulfilled Request, in ms:
+    queue (admission->dispatch), batch (dispatch->forward), infer
+    (the forward itself), fulfill (forward->response write). Missing
+    stamps collapse to zero-width stages (never negative, never NaN),
+    so the sum always ≈ total — the decomposition invariant the
+    tests pin."""
+    t_enq = req.t_enq if req.t_enq is not None else req.t_submit
+    t_dis = req.t_dispatch if req.t_dispatch is not None else t_enq
+    t_f0 = req.t_fwd0 if req.t_fwd0 is not None else t_dis
+    t_f1 = req.t_fwd1 if req.t_fwd1 is not None else t_f0
+    t_done = req.t_done if req.t_done is not None else t_f1
+    ms = lambda a, b: max(0.0, (b - a) * 1e3)  # noqa: E731
+    return {
+        "queue": ms(req.t_submit, t_dis),
+        "batch": ms(t_dis, t_f0),
+        "infer": ms(t_f0, t_f1),
+        "fulfill": ms(t_f1, max(t_done, now)),
+        "total": ms(req.t_submit, max(t_done, now)),
+    }
 
 
 class ServeStats:
@@ -86,7 +109,8 @@ class ServeStats:
         return out
 
 
-def _make_handler(engine, batcher, stats, timeout_s, member=None):
+def _make_handler(engine, batcher, stats, timeout_s, member=None,
+                  metrics=None, tracer=None, replica=None):
     from http.server import BaseHTTPRequestHandler
 
     class Handler(BaseHTTPRequestHandler):
@@ -95,11 +119,13 @@ def _make_handler(engine, batcher, stats, timeout_s, member=None):
         def log_message(self, fmt, *args):   # quiet access log
             pass
 
-        def _send_json(self, code, obj):
+        def _send_json(self, code, obj, headers=None):
             body = json.dumps(obj).encode("utf-8")
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
             self.end_headers()
             self.wfile.write(body)
 
@@ -137,8 +163,14 @@ def _make_handler(engine, batcher, stats, timeout_s, member=None):
             except ValueError as e:
                 self._send_json(400, {"error": str(e)})
                 return
+            # router-minted trace id rides the request header; the
+            # value is "<id>;<attempt>" so retries share one id
+            trace = None
+            raw = self.headers.get(TRACE_HEADER)
+            if raw:
+                trace = raw.split(";", 1)[0].strip() or None
             try:
-                req = batcher.submit(arrays, n=n)
+                req = batcher.submit(arrays, n=n, trace=trace)
             except RejectedError as e:
                 stats.record_reject()
                 self._send_json(429, {"error": str(e),
@@ -151,12 +183,33 @@ def _make_handler(engine, batcher, stats, timeout_s, member=None):
             if req.error is not None:
                 self._send_json(500, {"error": req.error})
                 return
+            stg = stage_breakdown(req, time.monotonic())
+            hdrs = {STAGES_HEADER: encode_stages(stg)}
+            if trace:
+                hdrs[TRACE_HEADER] = trace
             self._send_json(200, {
                 "outputs": {k: v.tolist() for k, v in req.result.items()},
                 "iter": engine.status().get("iter"),
                 "bucket": req.bucket,
                 "latency_ms": round((req.t_done - req.t_submit) * 1e3, 3),
-            })
+                "stages": {k: round(v, 3) for k, v in stg.items()},
+            }, headers=hdrs)
+            # replica-side exemplar: lets fleettrace place this
+            # request on the replica track with the router's id
+            if metrics is not None:
+                verdict = tracer.decide(stg["total"]) if tracer \
+                    is not None else "head"
+                if verdict is not None:
+                    metrics.log("serve_trace",
+                                src=f"replica{replica}"
+                                    if replica is not None else "replica",
+                                trace=trace, replica=replica, code=200,
+                                total_ms=round(stg["total"], 3),
+                                queue_ms=round(stg["queue"], 3),
+                                batch_ms=round(stg["batch"], 3),
+                                infer_ms=round(stg["infer"], 3),
+                                fulfill_ms=round(stg["fulfill"], 3),
+                                tail=verdict == "tail")
 
     return Handler
 
@@ -192,7 +245,8 @@ def _parse_inputs(payload, feed_shapes):
     return arrays, int(n)
 
 
-def _run_batch(engine, batcher, stats, metrics, reqs, wait_ms):
+def _run_batch(engine, batcher, stats, metrics, reqs, wait_ms,
+               tracer=None, chaos=None, replica=None):
     """One engine step for one closed batch; fulfills every Request."""
     rows = sum(r.n for r in reqs)
     depth = batcher.depth()
@@ -204,13 +258,23 @@ def _run_batch(engine, batcher, stats, metrics, reqs, wait_ms):
                  else np.zeros((r.n,) + tuple(per))
                  for r in reqs]
         arrays[name] = np.concatenate(parts, axis=0)
+    fwd0 = time.monotonic()
+    for r in reqs:
+        r.t_fwd0 = fwd0
+    if chaos is not None and replica is not None:
+        # injected slowness lands INSIDE the forward stage, matching
+        # the sim (which inflates service time) — so "where did the
+        # p99 go" names infer, the stage a slow accelerator shows as
+        chaos.maybe_slow_replica(int(replica))
     t0 = time.perf_counter()
     try:
         out, bucket = engine.forward(arrays, n=rows)
     except Exception as e:          # net-level failure -> 500s, keep serving
+        now = time.monotonic()
         for r in reqs:
             r.error = f"{type(e).__name__}: {e}"
-            r.t_done = time.monotonic()
+            r.t_fwd1 = now
+            r.t_done = now
             r.done.set()
         return
     infer_ms = (time.perf_counter() - t0) * 1e3
@@ -219,6 +283,7 @@ def _run_batch(engine, batcher, stats, metrics, reqs, wait_ms):
     for r in reqs:
         r.result = {k: v[off:off + r.n] for k, v in out.items()}
         r.bucket = bucket
+        r.t_fwd1 = now
         r.t_done = now
         off += r.n
         r.done.set()
@@ -230,15 +295,18 @@ def _run_batch(engine, batcher, stats, metrics, reqs, wait_ms):
                     queue_depth=depth, wait_ms=round(wait_ms, 3),
                     infer_ms=round(infer_ms, 3), iter=it)
         for r in reqs:
+            lat_ms = (r.t_done - r.t_submit) * 1e3
+            if tracer is not None and tracer.decide(lat_ms) is None:
+                continue    # head-sampled out; tails always kept
             metrics.log("serve_request",
-                        latency_ms=round((r.t_done - r.t_submit) * 1e3, 3),
+                        latency_ms=round(lat_ms, 3),
                         wait_ms=round(wait_ms, 3), rows=r.n,
                         bucket=bucket)
 
 
 def serve_loop(engine, batcher, stats, metrics=None, policy=None,
                reload_poll_s=0.0, stop_event=None, idle_timeout=0.05,
-               chaos=None, replica=None, log_fn=print):
+               chaos=None, replica=None, tracer=None, log_fn=print):
     """The single consumer thread: batches, signals, hot reload, drain.
     Returns 0 after a clean drain (the supervisor contract)."""
     log = log_fn or (lambda *a: None)
@@ -266,9 +334,10 @@ def serve_loop(engine, batcher, stats, metrics=None, policy=None,
             next_reload = time.monotonic() + reload_poll_s
         reqs, wait_ms = batcher.next_batch(timeout=idle_timeout)
         if reqs:
-            if inject:
-                chaos.maybe_slow_replica(int(replica))
-            _run_batch(engine, batcher, stats, metrics, reqs, wait_ms)
+            _run_batch(engine, batcher, stats, metrics, reqs, wait_ms,
+                       tracer=tracer,
+                       chaos=chaos if inject else None,
+                       replica=replica if inject else None)
             served += len(reqs)
             if inject:
                 # kill_replica fires AFTER the kill_req-th request is
@@ -282,7 +351,7 @@ def serve_loop(engine, batcher, stats, metrics=None, policy=None,
 def serve_http(engine, batcher, host="127.0.0.1", port=0, metrics=None,
                policy=None, reload_poll_s=0.0, stop_event=None,
                request_timeout_s=30.0, member=None, chaos=None,
-               replica=None, log_fn=print):
+               replica=None, tracer=None, log_fn=print):
     """Bind, announce, serve until drained; returns the exit code.
     With ``member`` (serve/fleet.py ReplicaMember) the replica leases
     into the fleet rendezvous once the socket is bound (the URL is in
@@ -291,7 +360,8 @@ def serve_http(engine, batcher, host="127.0.0.1", port=0, metrics=None,
     log = log_fn or (lambda *a: None)
     stats = ServeStats()
     handler = _make_handler(engine, batcher, stats, request_timeout_s,
-                            member=member)
+                            member=member, metrics=metrics,
+                            tracer=tracer, replica=replica)
     httpd = ThreadingHTTPServer((host, int(port)), handler)
     httpd.daemon_threads = True
     addr = f"http://{httpd.server_address[0]}:{httpd.server_address[1]}"
@@ -310,7 +380,7 @@ def serve_http(engine, batcher, host="127.0.0.1", port=0, metrics=None,
         rc = serve_loop(engine, batcher, stats, metrics=metrics,
                         policy=policy, reload_poll_s=reload_poll_s,
                         stop_event=stop_event, chaos=chaos,
-                        replica=replica, log_fn=log)
+                        replica=replica, tracer=tracer, log_fn=log)
     finally:
         httpd.shutdown()
         httpd.server_close()

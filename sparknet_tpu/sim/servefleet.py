@@ -36,6 +36,7 @@ import math
 
 import numpy as np
 
+from ..obs.tracing import STAGES, BurnRateLedger, TraceSampler
 from ..resilience.chaos import ChaosMonkey
 from ..serve.fleet import CanaryController, ReplicaMember, Router, \
     SLOAutoscaler
@@ -106,18 +107,24 @@ class _VReplica:
         return len(self._completions)
 
     def serve(self, body):
-        """-> (code, payload, latency_ms): the analytic queue step."""
+        """-> (code, payload, latency_ms, stages): the analytic queue
+        step. The stage breakdown uses the SAME shape the real replica
+        echoes in X-Sparknet-Stages (serve/server.py stage_breakdown),
+        so the router's tracing loop closes with zero special cases:
+        queue = backlog wait, infer = service time (chaos slowness
+        inflates it, matching the real tier where injected slowness
+        lands inside the forward)."""
         now = self.sim.clock.monotonic()
         if not self.up:
-            return (-1, b"", None)
+            return (-1, b"", None, None)
         if self.draining:
             return (429, json.dumps(
                 {"error": "draining", "reason": "replica_draining",
-                 "queue_depth": self.depth()}).encode(), 0.0)
+                 "queue_depth": self.depth()}).encode(), 0.0, None)
         if self.depth() >= self.sim.queue_limit:
             return (429, json.dumps(
                 {"error": "queue full", "reason": "queue_full",
-                 "queue_depth": self.depth()}).encode(), 0.0)
+                 "queue_depth": self.depth()}).encode(), 0.0, None)
         service = self.sim.service_s
         chaos = self.sim.chaos
         if chaos is not None:
@@ -130,6 +137,11 @@ class _VReplica:
         self._completions.append(done)
         self.served += 1
         lat_ms = (done - now) * 1e3
+        stages = {"total": lat_ms,
+                  "queue": (start - now) * 1e3,
+                  "batch": 0.0,
+                  "infer": service * 1e3,
+                  "fulfill": 0.0}
         if chaos is not None and \
                 chaos.replica_kill_due(self.rid, self.served):
             # dispatch-then-die: THIS request is fulfilled, then the
@@ -137,12 +149,13 @@ class _VReplica:
             # holds and never re-send
             self.sim.kill(self, why="chaos kill_replica")
             self.sim.lat_ms.append(lat_ms)
-            return (200, b'{"outputs": {}}', lat_ms)
+            return (200, b'{"outputs": {}}', lat_ms, stages)
         if self.err_p > 0 and self.sim.rng.random_sample() < self.err_p:
             return (500, json.dumps(
-                {"error": f"sim fault on {self.sha}"}).encode(), lat_ms)
+                {"error": f"sim fault on {self.sha}"}).encode(),
+                lat_ms, None)
         self.sim.lat_ms.append(lat_ms)
-        return (200, b'{"outputs": {}}', lat_ms)
+        return (200, b'{"outputs": {}}', lat_ms, stages)
 
 
 class ServeFleetSim:
@@ -178,7 +191,8 @@ class ServeFleetSim:
                  spawn_delay_s=1.0, canary_w=0, canary_pct=20.0,
                  canary_err=1.0, canary_min_requests=10,
                  die_w=None, rejoin_w=None, chaos=None, seed=0,
-                 metrics=None, log_fn=None):
+                 trace_sample=1.0, tail_ms=None, slo_burn=False,
+                 burn_scale=1.0, metrics=None, log_fn=None):
         if trace not in TRACES:
             raise ValueError(f"unknown arrival trace {trace!r} "
                              f"(valid: {', '.join(TRACES)})")
@@ -215,7 +229,13 @@ class ServeFleetSim:
             self.dirops.root, replicas=self.replicas,
             lease_s=self.lease_s, canary=self.canary, metrics=metrics,
             log_fn=self.log, clock=self.clock, dirops=self.dirops,
-            post_fn=self._post)
+            post_fn=self._post,
+            tracer=TraceSampler(sample=float(trace_sample),
+                                tail_ms=tail_ms),
+            slo=BurnRateLedger(slo_ms=float(slo_p99_ms),
+                               scale=float(burn_scale),
+                               metrics=metrics, log_fn=self.log)
+            if slo_burn else None)
         self.autoscaler = SLOAutoscaler(
             p99_ms=float(slo_p99_ms), depth=int(slo_depth),
             windows=int(breach_windows), idle_windows=int(idle_windows),
@@ -231,11 +251,14 @@ class ServeFleetSim:
         self.spawned = []
 
     # -- transport + processes ----------------------------------------------
-    def _post(self, url, body, timeout):
+    def _post(self, url, body, timeout, headers=None):
+        # accepting ``headers`` tells the router this transport can
+        # carry the X-Sparknet-Trace header — the propagation path the
+        # real tier uses, exercised verbatim in sim
         for rep in self.reps:
             if rep.member.url == url:
                 return rep.serve(body)
-        return (-1, b"", None)
+        return (-1, b"", None, None)
 
     def kill(self, rep, why=""):
         """A replica dies: it stops beating and stops answering; its
@@ -358,6 +381,11 @@ class ServeFleetSim:
                    if a == "grow")
         shrink = sum(1 for _, a in self.autoscaler.decisions
                      if a == "shrink")
+        # attributed tail: which stage owns the p99 (the knob-ranking
+        # signal a `simfleet --serve` sweep sorts by)
+        stages_p99 = self.router.stages.p99()
+        ranked = [(v, k) for k, v in stages_p99.items() if k in STAGES]
+        top_stage = max(ranked)[1] if ranked else None
         return {
             "replicas": self.replicas,
             "replicas_final": self.router.policy.live_count(),
@@ -379,4 +407,8 @@ class ServeFleetSim:
             "canary_rollbacks": self.canary.rollbacks,
             "killed": list(self.killed), "spawned": list(self.spawned),
             "quorum_lost": bool(self.router.quorum_lost),
+            "stages_p99": stages_p99,
+            "top_stage": top_stage,
+            "burn": (self.router.slo.snapshot()
+                     if self.router.slo is not None else None),
         }

@@ -1,0 +1,435 @@
+"""Request-level tracing (obs/tracing.py + ISSUE 18): stage-
+decomposition invariant (sync batcher and sim), trace-id propagation
+across a retry, head-sampling with always-kept tail exemplars and
+bounded event volume, the SLO burn-rate ledger's two-window alert
+ladder, and the report/monitor rendering of serve_trace + slo_burn."""
+
+import time
+
+import numpy as np
+import pytest
+
+from conftest import REFERENCE  # noqa: F401  (conftest sets the cpu env)
+
+from sparknet_tpu.obs.tracing import (BurnRateLedger, StageReservoir,
+                                      TraceSampler, decode_stages,
+                                      encode_stages)
+from sparknet_tpu.serve.batcher import Batcher
+from sparknet_tpu.serve.fleet import Router
+from sparknet_tpu.serve.server import ServeStats, _run_batch, \
+    stage_breakdown
+from sparknet_tpu.sim import MemDir, ServeFleetSim, SimClock
+
+
+class _Sink:
+    def __init__(self):
+        self.events = []
+
+    def log(self, event, **fields):
+        self.events.append(dict(fields, event=event))
+
+    def of(self, kind):
+        return [e for e in self.events if e["event"] == kind]
+
+
+def _quiet(*a, **k):
+    pass
+
+
+# ----------------------------------------------------- header codec ----
+class TestStageHeaderCodec:
+    def test_round_trip(self):
+        stg = {"total": 12.345, "queue": 4.5, "infer": 7.1,
+               "batch": 0.0, "fulfill": 0.745}
+        out = decode_stages(encode_stages(stg))
+        assert out == pytest.approx(stg, abs=1e-3)
+
+    def test_none_values_dropped(self):
+        out = decode_stages(encode_stages({"total": 5.0, "net": None}))
+        assert out == {"total": 5.0}
+
+    def test_garbage_is_none_not_a_crash(self):
+        assert decode_stages(None) is None
+        assert decode_stages("") is None
+        assert decode_stages("not a header") is None
+        # partial garbage keeps the parseable part
+        assert decode_stages("total=5.0;junk;x=y") == {"total": 5.0}
+
+
+# ----------------------------------------------------- TraceSampler ----
+class TestTraceSampler:
+    def test_default_keeps_every_request(self):
+        s = TraceSampler()
+        assert all(s.decide(float(i)) == "head" for i in range(50))
+
+    def test_stride_bounds_event_volume(self):
+        s = TraceSampler(sample=0.05)
+        kept = sum(1 for _ in range(1000) if s.decide(1.0))
+        assert kept == 50            # deterministic, not probabilistic
+
+    def test_tail_always_kept_regardless_of_stride(self):
+        s = TraceSampler(sample=0.0, tail_ms=100.0)
+        assert all(s.decide(5.0) is None for _ in range(100))
+        assert s.decide(100.0) == "tail"
+        assert s.decide(5000.0) == "tail"
+
+    def test_tail_does_not_consume_the_stride(self):
+        s = TraceSampler(sample=0.5, tail_ms=100.0)
+        verdicts = [s.decide(200.0) for _ in range(4)]
+        assert verdicts == ["tail"] * 4
+        # head stream unaffected: every 2nd fast request still kept
+        fast = [s.decide(1.0) for _ in range(4)]
+        assert fast.count("head") == 2
+
+
+# --------------------------------------------------- StageReservoir ----
+class TestStageReservoir:
+    def test_snapshot_percentiles_per_stage(self):
+        r = StageReservoir(cap=128)
+        for i in range(100):
+            r.add({"queue": float(i), "infer": 10.0, "net": None})
+        snap = r.snapshot()
+        assert snap["infer"]["p99"] == pytest.approx(10.0)
+        assert snap["queue"]["n"] == 100
+        assert snap["queue"]["p99"] >= snap["queue"]["p50"]
+        assert "net" not in snap     # None samples never recorded
+        assert r.p99()["infer"] == pytest.approx(10.0)
+
+    def test_window_slides_at_cap(self):
+        r = StageReservoir(cap=10)
+        for i in range(100):
+            r.add({"queue": float(i)})
+        assert r.snapshot()["queue"]["n"] == 10
+        assert r.snapshot()["queue"]["p50"] >= 90.0
+
+
+# --------------------------------------------------- BurnRateLedger ----
+class TestBurnRateLedger:
+    def test_sli_latency_bound(self):
+        led = BurnRateLedger(slo_ms=100.0)
+        assert led.good(200, 50.0)
+        assert not led.good(200, 150.0)  # met the code, blew the SLO
+        assert not led.good(500, 1.0)
+        assert not led.good(200, None)
+
+    def test_all_bad_pages_and_exhausts_the_budget(self):
+        led = BurnRateLedger(slo_ms=100.0, objective=0.999, scale=0.01)
+        for i in range(100):
+            led.record(i * 0.1, good=False)
+        out = led.evaluate(10.0)
+        assert out["alert"] == "page"
+        assert out["fast"] > 14.4 and out["fast_long"] > 14.4
+        assert out["budget_left"] == 0.0
+        assert led.snapshot()["alert"] == "page"
+
+    def test_slow_leak_tickets_without_paging(self):
+        # 1% bad at objective 99.9% = burn x10: above the ticket
+        # threshold (6), below the page threshold (14.4)
+        led = BurnRateLedger(slo_ms=100.0, objective=0.999, scale=0.01)
+        for i in range(1000):
+            led.record(i * 0.01, good=i % 100 != 0)
+        out = led.evaluate(10.0)
+        assert out["alert"] == "ticket"
+        assert 6.0 < out["fast"] < 14.4
+
+    def test_healthy_traffic_never_alerts(self):
+        led = BurnRateLedger(slo_ms=100.0, scale=0.01)
+        for i in range(200):
+            led.record(i * 0.05, good=True)
+        out = led.evaluate(10.0)
+        assert out["alert"] is None
+        assert out["budget_left"] == 1.0
+
+    def test_emits_one_slo_burn_event_per_evaluation(self):
+        sink = _Sink()
+        led = BurnRateLedger(slo_ms=100.0, scale=0.01, metrics=sink)
+        for i in range(50):
+            led.record(i * 0.1, good=False)
+        led.evaluate(5.0)
+        led.evaluate(6.0)
+        ev = sink.of("slo_burn")
+        assert len(ev) == 2          # window cadence, not QPS
+        assert ev[-1]["alert"] == "page" and ev[-1]["bad"] == 50
+
+    def test_alert_transition_is_logged_once(self):
+        lines = []
+        led = BurnRateLedger(slo_ms=100.0, scale=0.01,
+                             log_fn=lambda m: lines.append(m))
+        for i in range(50):
+            led.record(i * 0.1, good=False)
+        led.evaluate(5.0)
+        led.evaluate(5.5)            # still paging: no repeat log
+        assert sum("page" in ln for ln in lines) == 1
+
+
+# --------------------------------- sync decomposition (serve tier) ----
+class _TraceEngine:
+    def __init__(self, infer_s=0.02):
+        self.infer_s = infer_s
+
+    def feed_shapes(self):
+        return {"x": (4,)}
+
+    def forward(self, arrays, n):
+        time.sleep(self.infer_s)
+        return {"y": np.zeros((n, 2))}, int(n)
+
+    def status(self):
+        return {"sha": "sha-t", "iter": 1}
+
+
+class TestStageDecompositionSync:
+    def test_stage_sums_telescope_to_total(self):
+        b = Batcher(max_batch=4, max_wait_s=0.01, queue_limit=16)
+        reqs_in = [b.submit({"x": np.zeros((1, 4))}, n=1,
+                            trace=f"t{i}") for i in range(3)]
+        reqs, wait_ms = b.next_batch(timeout=1.0)
+        assert len(reqs) == 3
+        _run_batch(_TraceEngine(), b, ServeStats(), None, reqs, wait_ms)
+        now = time.monotonic()
+        for req in reqs_in:
+            assert req.done.is_set() and req.error is None
+            stg = stage_breakdown(req, now)
+            total = stg.pop("total")
+            # the invariant the decomposition is built on: stage
+            # boundaries telescope, so the parts SUM to the whole
+            assert sum(stg.values()) == pytest.approx(
+                total, abs=max(0.1 * total, 5.0))
+            assert stg["infer"] >= 15.0      # the injected 20ms sleep
+            assert all(v >= 0.0 for v in stg.values())
+
+    def test_missing_stamps_collapse_to_zero_width(self):
+        # a request rejected before dispatch still decomposes: every
+        # un-stamped stage is zero-width, never negative or NaN
+        req = Batcher(max_batch=4, queue_limit=16).submit(
+            {"x": np.zeros((1, 4))}, n=1)
+        stg = stage_breakdown(req, time.monotonic())
+        assert stg["batch"] == 0.0 and stg["infer"] == 0.0
+        assert sum(v for k, v in stg.items() if k != "total") == \
+            pytest.approx(stg["total"], abs=1e-6)
+
+    def test_forward_error_still_stamps_the_request(self):
+        class _Boom(_TraceEngine):
+            def forward(self, arrays, n):
+                raise RuntimeError("boom")
+
+        b = Batcher(max_batch=4, max_wait_s=0.01, queue_limit=16)
+        req = b.submit({"x": np.zeros((1, 4))}, n=1)
+        reqs, wait_ms = b.next_batch(timeout=1.0)
+        _run_batch(_Boom(), b, ServeStats(), None, reqs, wait_ms)
+        assert req.error is not None
+        assert req.t_fwd1 is not None and req.t_done is not None
+
+
+# --------------------------------------- router trace propagation ----
+class TestRouterTracePropagation:
+    def _fleet(self, n, post_fn, **kw):
+        from sparknet_tpu.serve.fleet import ReplicaMember
+
+        class _FakeBatcher:
+            def depth(self):
+                return 0
+
+            def pending(self):
+                return 0
+
+            def draining(self):
+                return False
+
+        class _FakeEngine:
+            def status(self):
+                return {"sha": "sha-a", "iter": 7}
+
+        clock = SimClock()
+        d = MemDir(clock)
+        for r in range(n):
+            ReplicaMember(d.root, r, replicas=n, interval_s=0.2,
+                          lease_s=1.0, log_fn=_quiet, clock=clock,
+                          dirops=d, engine=_FakeEngine(),
+                          batcher=_FakeBatcher(),
+                          url=f"sim://replica/{r}").coord.beat()
+        kw.setdefault("log_fn", _quiet)
+        rt = Router(d.root, replicas=n, lease_s=1.0, clock=clock,
+                    dirops=d, post_fn=post_fn, **kw)
+        rt.poll()
+        return clock, rt
+
+    def test_one_trace_id_spans_a_retry(self):
+        seen = []
+
+        def post(url, body, t, headers=None):
+            seen.append(dict(headers or {}))
+            if len(seen) == 1:
+                return -1, b"", None, None      # no response received
+            return 200, b"{}", 50.0, {"total": 40.0, "queue": 30.0,
+                                      "batch": 0.0, "infer": 10.0,
+                                      "fulfill": 0.0}
+
+        sink = _Sink()
+        clock, rt = self._fleet(3, post, metrics=sink,
+                                tracer=TraceSampler())
+        code, _data = rt.dispatch(b"{}")
+        assert code == 200
+        # both attempts carried the SAME trace id, distinct attempts
+        assert len(seen) == 2
+        ids = [h["X-Sparknet-Trace"].split(";")[0] for h in seen]
+        atts = [h["X-Sparknet-Trace"].split(";")[1] for h in seen]
+        assert ids[0] == ids[1] and atts == ["1", "2"]
+        ev = sink.of("serve_trace")
+        assert len(ev) == 1
+        tr = ev[0]
+        assert tr["src"] == "router" and tr["trace"] == ids[0]
+        assert tr["attempts"] == 2 and tr["retried"] is True
+        # one span per attempt; the failed hop is visible in the trace
+        assert [s["code"] for s in tr["spans"]] == [-1, 200]
+        assert tr["spans"][0]["replica"] != tr["spans"][1]["replica"]
+        # the request is attributed to the replica that ANSWERED
+        assert tr["replica"] == tr["spans"][1]["replica"]
+        # net closes the loop: router total − server-reported total
+        assert tr["total_ms"] == pytest.approx(50.0)
+        assert tr["server_ms"] == pytest.approx(40.0)
+        assert tr["net_ms"] == pytest.approx(10.0)
+        assert tr["queue_ms"] == pytest.approx(30.0)
+
+    def test_stage_reservoir_and_echo_headers(self):
+        def post(url, body, t, headers=None):
+            return 200, b"{}", 25.0, {"total": 20.0, "queue": 5.0,
+                                      "batch": 1.0, "infer": 12.0,
+                                      "fulfill": 2.0}
+
+        clock, rt = self._fleet(2, post)
+        for _ in range(8):
+            code, _data, hdrs = rt.dispatch(b"{}", want_headers=True)
+            assert code == 200
+        # the front end re-echoes trace id + stage breakdown
+        assert "X-Sparknet-Trace" in hdrs
+        echoed = decode_stages(hdrs["X-Sparknet-Stages"])
+        assert echoed["infer"] == pytest.approx(12.0)
+        snap = rt.stats_snapshot()
+        assert snap["stages"]["infer"]["p99"] == pytest.approx(12.0)
+        assert snap["stages"]["net"]["p99"] == pytest.approx(5.0)
+        assert snap["retry_rate"] == 0.0
+        assert sum(snap["dispatch_share"].values()) == pytest.approx(
+            1.0, abs=0.01)
+        assert rt.status()["stages_p99"]["infer"] == pytest.approx(12.0)
+
+    def test_legacy_two_tuple_post_fn_still_works(self):
+        # a post_fn without a headers parameter never receives one,
+        # and a bare (code, body) return still routes
+        clock, rt = self._fleet(2, lambda u, b, t: (200, b"{}"))
+        assert rt.dispatch(b"{}")[0] == 200
+        assert rt.stats_snapshot()["stages"] == {}
+
+    def test_burn_ledger_rides_the_window_loop(self):
+        def post(url, body, t, headers=None):
+            return 200, b"{}", 900.0, {"total": 890.0}  # blows the SLO
+
+        sink = _Sink()
+        clock, rt = self._fleet(
+            2, post, metrics=sink,
+            slo=BurnRateLedger(slo_ms=100.0, scale=0.01, metrics=sink,
+                               log_fn=_quiet))
+        for _ in range(20):
+            rt.dispatch(b"{}")
+            clock.sleep(0.05)
+        w = rt.window_stats()
+        assert w["burn"]["alert"] == "page"
+        assert rt.stats_snapshot()["slo_burn"]["alert"] == "page"
+        assert sink.of("slo_burn")[-1]["alert"] == "page"
+
+
+# ------------------------------------------------- sim decomposition ----
+class TestSimTracing:
+    def test_sim_stages_decompose_and_name_the_slow_stage(self):
+        from sparknet_tpu.resilience.chaos import ChaosMonkey
+        sink = _Sink()
+        chaos = ChaosMonkey.parse("slow_replica=1,slow_ms=100",
+                                  log_fn=_quiet)
+        s = ServeFleetSim(replicas=2, windows=10, rate=20.0,
+                          chaos=chaos, metrics=sink, seed=3,
+                          slo_burn=True, burn_scale=0.01,
+                          slo_p99_ms=50.0, tail_ms=80.0)
+        out = s.run()
+        assert out["lost"] == 0
+        # every router trace decomposes: stages sum to the total
+        routed = [e for e in sink.of("serve_trace")
+                  if e["src"] == "router" and e["code"] == 200]
+        assert routed
+        for e in routed:
+            parts = sum(e[f"{k}_ms"] or 0.0 for k in
+                        ("net", "queue", "batch", "infer", "fulfill"))
+            assert parts == pytest.approx(
+                e["total_ms"], abs=max(0.1 * e["total_ms"], 0.5))
+        assert out["stages_p99"]["infer"] >= 100.0   # the injected slow
+        assert out["top_stage"] in ("infer", "queue")
+        assert any(e["tail"] for e in routed)        # exemplars kept
+        # the budget ledger saw the breach
+        assert out["burn"] is not None
+        assert out["burn"]["alert"] is not None
+
+    def test_head_sampling_bounds_sim_event_volume(self):
+        sink = _Sink()
+        s = ServeFleetSim(replicas=3, windows=10, rate=30.0, seed=3,
+                          metrics=sink, trace_sample=0.1)
+        out = s.run()
+        n_traces = len(sink.of("serve_trace"))
+        assert 0 < n_traces <= out["responses"] // 10 + 1
+
+    def test_default_knobs_emit_no_burn_events(self):
+        sink = _Sink()
+        ServeFleetSim(replicas=2, windows=6, rate=20.0, seed=3,
+                      metrics=sink).run()
+        assert sink.of("slo_burn") == []
+
+
+# ------------------------------------------------- report + monitor ----
+def _trace_event(i, total, queue=3.0, infer=6.0, tail=False):
+    net = max(0.0, total - queue - infer)
+    return {"event": "serve_trace", "src": "router", "trace": f"t{i}",
+            "replica": 0, "code": 200, "attempts": 1, "retried": False,
+            "total_ms": total, "server_ms": queue + infer,
+            "net_ms": net, "queue_ms": queue, "batch_ms": 0.0,
+            "infer_ms": infer, "fulfill_ms": 0.0, "tail": tail,
+            "spans": [{"replica": 0, "code": 200, "start_ms": 0.0,
+                       "dur_ms": total}]}
+
+
+class TestReportAndMonitorRendering:
+    def _events(self):
+        evs = [_trace_event(i, total=10.0) for i in range(99)]
+        # one fat-tailed request whose milliseconds sit in infer
+        evs.append(_trace_event(99, total=500.0, queue=5.0,
+                                infer=490.0, tail=True))
+        evs.append({"event": "slo_burn", "alert": "page", "fast": 20.0,
+                    "fast_long": 16.0, "slow": 8.0, "slow_long": 7.0,
+                    "budget_left": 0.1, "good": 90, "bad": 10})
+        return evs
+
+    def test_report_attributes_the_p99_to_the_right_stage(self):
+        from sparknet_tpu.obs import report
+        rep = report.aggregate(self._events())
+        tr = rep["tracing"]
+        assert tr["traces"] == 100 and tr["tails"] == 1
+        assert tr["top_stage"] == "infer"
+        attr = tr["p99_attribution"]
+        # attribution sums to the tail cohort's mean total
+        assert sum(attr.values()) == pytest.approx(
+            tr["p99_cohort_ms"], rel=0.1)
+        bn = rep["slo_burn"]
+        assert bn["alerts"] == {"page": 1}
+        assert bn["last"]["budget_left"] == 0.1
+        text = report.render(rep)
+        assert "where did the p99 go" in text
+        assert "top stage infer" in text
+        assert "slo error budget" in text
+        assert "page" in text
+
+    def test_monitor_renders_tracing_and_burn_lines(self):
+        from sparknet_tpu.obs.monitor import MonitorState
+        st = MonitorState()
+        for ev in self._events():
+            st.update(ev)
+        text = st.render()
+        assert "tracing: traces 100  tails 1" in text
+        assert "top stage infer" in text
+        assert "slo burn:" in text and "ALERT page" in text
